@@ -1,0 +1,26 @@
+"""Pure-array decision kernels (the device-side half of each algorithm).
+
+In the reference, search decisions (ASHA rung cuts, PBT exploit/explore,
+TPE acquisition) happen host-side after an ``MPI_Allgather`` of scores
+(SURVEY.md §3; reference unreadable — contract from BASELINE.json).
+Here each decision is a pure function over arrays so it can run *inside*
+the jitted population step on TPU: scores never leave the chip between
+generations, and the decision costs one ``lax.top_k`` instead of a
+collective + host round-trip.
+
+All kernels follow the convention **higher score is better**; callers
+negate losses.
+"""
+
+from mpi_opt_tpu.ops.asha import asha_cut, asha_rungs
+from mpi_opt_tpu.ops.pbt import pbt_exploit_explore, PBTConfig
+from mpi_opt_tpu.ops.tpe import tpe_suggest, TPEConfig
+
+__all__ = [
+    "asha_cut",
+    "asha_rungs",
+    "pbt_exploit_explore",
+    "PBTConfig",
+    "tpe_suggest",
+    "TPEConfig",
+]

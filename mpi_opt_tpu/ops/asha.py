@@ -1,0 +1,59 @@
+"""ASHA rung reduction as an on-device top-k.
+
+Reference behavior (SURVEY.md §2 row 4; reference unreadable): ASHA
+promotes the top 1/eta of trials at each rung to the next budget level
+and early-stops the rest, asynchronously across MPI ranks. On TPU the
+whole rung cohort is one population axis, so the reduction is a single
+``lax.top_k`` — no Allgather, no host round-trip.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_opt_tpu.ops.common import rank_descending
+
+
+def asha_rungs(min_budget: int, max_budget: int, eta: int) -> list[int]:
+    """Budget ladder [min_budget, min_budget*eta, ...] up to max_budget."""
+    if min_budget < 1 or eta < 2 or max_budget < min_budget:
+        raise ValueError("need min_budget>=1, eta>=2, max_budget>=min_budget")
+    rungs = []
+    b = min_budget
+    while b < max_budget:
+        rungs.append(b)
+        b *= eta
+    rungs.append(max_budget)
+    return rungs
+
+
+def asha_cut(scores: jax.Array, eta: int, valid: jax.Array | None = None):
+    """Select the top ceil(n_valid/eta) of a rung cohort.
+
+    Args:
+        scores: ``float32[n]`` objective values, higher is better.
+        eta: reduction factor (>=2).
+        valid: optional ``bool[n]``; invalid slots never promote.
+
+    Returns:
+        (promote: bool[n], order: int32[n]) — ``promote[i]`` is True iff
+        member i survives the cut; ``order`` is the index array sorting
+        scores descending (useful for gathers). Jittable; ``n`` and
+        ``eta`` are static.
+    """
+    n = scores.shape[0]
+    if valid is None:
+        valid = jnp.ones((n,), dtype=bool)
+    rank, order = rank_descending(scores, valid)
+    n_valid = jnp.sum(valid)
+    k = jnp.ceil(n_valid / eta).astype(jnp.int32)  # dynamic but bounded by n
+    promote = (rank < k) & valid
+    return promote, order
+
+
+def asha_top_k_dense(scores: jax.Array, k: int):
+    """Static-k variant for fully-populated rungs: plain ``lax.top_k``."""
+    vals, idx = lax.top_k(scores, k)
+    return vals, idx

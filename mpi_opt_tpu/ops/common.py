@@ -1,0 +1,22 @@
+"""Shared array idioms for the decision kernels."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rank_descending(scores: jax.Array, valid: jax.Array | None = None):
+    """Dense descending rank of each score (0 = best).
+
+    Invalid entries (and NaNs, which sort last under jnp.argsort) rank
+    after all valid finite entries.
+
+    Returns:
+        (rank: int32[n], order: int32[n]) — ``order`` sorts scores
+        descending; ``rank = argsort(order)`` is its inverse.
+    """
+    masked = scores if valid is None else jnp.where(valid, scores, -jnp.inf)
+    order = jnp.argsort(-masked)
+    rank = jnp.argsort(order)
+    return rank, order

@@ -1,0 +1,137 @@
+"""Tree-structured Parzen Estimator with a fully vectorized acquisition.
+
+Reference behavior (SURVEY.md §2 row 6; reference unreadable): TPE
+splits observed trials into good/bad by a score quantile, fits Parzen
+KDEs l(x) (good) and g(x) (bad), and suggests points maximizing
+l(x)/g(x).
+
+TPU-native design decisions:
+
+- **Fixed-shape observation buffer.** Observations live in a ring buffer
+  ``obs_unit: float32[M, d]`` with ``valid: bool[M]`` so the whole
+  suggest step compiles ONCE (no recompiles as history grows — the
+  classic Python TPE refits sklearn KDEs per call).
+- **Vectorized acquisition.** Candidates are sampled from the good
+  mixture and all scored in one batched computation (the config-4
+  workload: score thousands of candidates per suggest). The density
+  evaluation is a single ``[C, M, d]`` broadcast — MXU/VPU friendly,
+  no Python loop over candidates.
+- Everything is in unit-cube space; discrete dims are smoothed as
+  continuous here and re-quantized by ``Domain.from_unit`` at the edge.
+
+Bandwidths use Silverman's rule per dim over the respective subset,
+floored to keep the mixture proper when points coincide.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from mpi_opt_tpu.ops.common import rank_descending
+
+
+@dataclasses.dataclass(frozen=True)
+class TPEConfig:
+    gamma: float = 0.25  # top quantile regarded as "good"
+    n_candidates: int = 1024  # candidates scored per suggest call
+    # Minimum KDE bandwidth in unit space. Deliberately wide: Silverman
+    # on a converged good-set collapses, and a collapsed l(x) can never
+    # propose outside the incumbent cluster (on quadratic + branin test
+    # functions, floor 0.15 beat 0.03 by ~7x in final regret).
+    bw_floor: float = 0.15
+    bw_scale: float = 1.06  # Silverman factor
+    prior_weight: float = 1.0  # weight of the uniform prior component
+    # Fraction of candidates drawn uniformly from the cube rather than
+    # from the good mixture. Without this the search self-traps: once a
+    # cluster of observations forms, candidates only appear near it and
+    # unexplored regions (whose acquisition log((nb+1)/(ng+1)) > 0 is
+    # competitive) are never even scored.
+    uniform_frac: float = 0.1
+
+
+def _masked_moments(x, w):
+    """Weighted mean/std along axis 0. w: [M] nonneg, x: [M, d]."""
+    wsum = jnp.maximum(w.sum(), 1e-9)
+    mean = (w[:, None] * x).sum(0) / wsum
+    var = (w[:, None] * (x - mean) ** 2).sum(0) / wsum
+    return mean, jnp.sqrt(var)
+
+
+def _log_mixture(x, centers, w, bw, prior_weight):
+    """log density of x under masked Gaussian mixture + uniform prior.
+
+    x: [C, d]; centers: [M, d]; w: [M] (0 for invalid); bw: [d].
+    Uniform-on-[0,1] prior acts as one extra component with weight
+    ``prior_weight`` (its log-density is 0 per dim).
+    Returns [C].
+    """
+    # [C, M, d] broadcast — the hot tensor; C and M are static.
+    z = (x[:, None, :] - centers[None, :, :]) / bw[None, None, :]
+    log_comp = (-0.5 * z**2 - jnp.log(bw)[None, None, :] - 0.5 * jnp.log(2 * jnp.pi)).sum(-1)
+    logw = jnp.where(w > 0, jnp.log(jnp.maximum(w, 1e-30)), -jnp.inf)
+    total = w.sum() + prior_weight
+    # prior component: log-density 0 over the unit cube
+    stacked = jnp.concatenate(
+        [log_comp + logw[None, :], jnp.full((x.shape[0], 1), jnp.log(prior_weight + 1e-30))],
+        axis=1,
+    )
+    return jax.scipy.special.logsumexp(stacked, axis=1) - jnp.log(total)
+
+
+def tpe_suggest(
+    key: jax.Array,
+    obs_unit: jax.Array,  # float32[M, d] ring buffer of observed points
+    obs_scores: jax.Array,  # float32[M], higher is better
+    valid: jax.Array,  # bool[M]
+    n_suggest: int,
+    cfg: TPEConfig = TPEConfig(),
+):
+    """Suggest ``n_suggest`` unit-cube points maximizing l(x)/g(x).
+
+    Fully jittable with static shapes; with an empty buffer it degrades
+    gracefully to uniform sampling through the prior component.
+
+    Returns:
+        suggestions: float32[n_suggest, d]
+        acq: float32[n_suggest] — log l - log g of each suggestion.
+    """
+    M, d = obs_unit.shape
+    k_uni, k_pick, k_jitter = jax.random.split(key, 3)
+
+    n_valid = valid.sum()
+    n_good = jnp.maximum(1, jnp.ceil(cfg.gamma * n_valid)).astype(jnp.int32)
+
+    rank, _ = rank_descending(obs_scores, valid)
+    good_w = ((rank < n_good) & valid).astype(jnp.float32)
+    bad_w = ((rank >= n_good) & valid).astype(jnp.float32)
+
+    # Silverman bandwidth per subset, per dim (floored)
+    def bw_of(w):
+        m = jnp.maximum(w.sum(), 1.0)
+        _, std = _masked_moments(obs_unit, w)
+        return jnp.clip(cfg.bw_scale * std * m ** (-1.0 / (d + 4)), cfg.bw_floor, 1.0)
+
+    bw_g, bw_b = bw_of(good_w), bw_of(bad_w)
+
+    # sample candidates from the good mixture (+ prior): pick a good
+    # center (or the prior) proportionally to weight, add bw noise.
+    total_g = good_w.sum() + cfg.prior_weight
+    probs = jnp.concatenate([good_w, jnp.array([cfg.prior_weight])]) / total_g
+    comp = jax.random.choice(k_pick, M + 1, (cfg.n_candidates,), p=probs)
+    centers = jnp.concatenate([obs_unit, jnp.full((1, d), 0.5)], axis=0)[comp]
+    widths = jnp.where((comp < M)[:, None], bw_g[None, :], 0.5)  # prior ~ wide
+    cand = centers + jax.random.normal(k_jitter, (cfg.n_candidates, d)) * widths
+    # exploration quota: first uniform_frac of candidates are uniform draws
+    n_uni = int(round(cfg.n_candidates * cfg.uniform_frac))
+    is_uni = (jnp.arange(cfg.n_candidates) < n_uni)[:, None]
+    cand = jnp.where(is_uni, jax.random.uniform(k_uni, (cfg.n_candidates, d)), cand)
+    cand = jnp.clip(cand, 0.0, 1.0)
+
+    acq = _log_mixture(cand, obs_unit, good_w, bw_g, cfg.prior_weight) - _log_mixture(
+        cand, obs_unit, bad_w, bw_b, cfg.prior_weight
+    )
+    top_acq, top_idx = jax.lax.top_k(acq, n_suggest)
+    return cand[top_idx], top_acq

@@ -1,0 +1,198 @@
+"""Search-space definition, array-first.
+
+Every domain maps to/from the unit cube so that whole populations of
+hyperparameters are plain ``float32[n, d]`` arrays on device:
+
+- algorithms (TPE acquisition, PBT explore perturbations) operate on the
+  unit-cube representation with pure ``jax.numpy`` ops and therefore
+  ``vmap``/``jit`` cleanly;
+- the typed value view (log-scaled floats, ints, categorical choices) is
+  materialised only at the edge, either host-side (``materialize``) or
+  on-device (``from_unit`` is itself jittable).
+
+Reference parity: mpi_opt's search-space (uniform / log-uniform /
+choice parameters fed to its optimizer; reference unreadable, surface per
+SURVEY.md §2 row 3) — re-designed so sampling is a single vectorized op
+instead of per-trial Python objects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Domain:
+    """Base class for one hyperparameter's domain.
+
+    Subclasses define a bijection (up to quantization) between the unit
+    interval [0, 1] and the typed value space.
+    """
+
+    def from_unit(self, u: jax.Array) -> jax.Array:
+        """Map unit-interval array -> value array (jittable)."""
+        raise NotImplementedError
+
+    def to_unit(self, v: jax.Array) -> jax.Array:
+        """Map value array -> unit interval (jittable)."""
+        raise NotImplementedError
+
+    def materialize(self, v: Any):
+        """Convert a scalar array element to the Python-typed value."""
+        return float(v)
+
+    @property
+    def discrete(self) -> bool:
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def __post_init__(self):
+        if not self.low < self.high:
+            raise ValueError(f"Uniform requires low < high, got [{self.low}, {self.high}]")
+
+    def from_unit(self, u):
+        return self.low + (self.high - self.low) * u
+
+    def to_unit(self, v):
+        return (v - self.low) / (self.high - self.low)
+
+
+@dataclasses.dataclass(frozen=True)
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def __post_init__(self):
+        if self.low <= 0 or self.high <= 0:
+            raise ValueError("LogUniform bounds must be positive")
+        if not self.low < self.high:
+            raise ValueError(f"LogUniform requires low < high, got [{self.low}, {self.high}]")
+
+    def from_unit(self, u):
+        lo, hi = np.log(self.low), np.log(self.high)
+        return jnp.exp(lo + (hi - lo) * u)
+
+    def to_unit(self, v):
+        lo, hi = np.log(self.low), np.log(self.high)
+        return (jnp.log(v) - lo) / (hi - lo)
+
+
+@dataclasses.dataclass(frozen=True)
+class IntUniform(Domain):
+    low: int
+    high: int  # inclusive
+
+    def __post_init__(self):
+        if not self.low <= self.high:
+            raise ValueError(f"IntUniform requires low <= high, got [{self.low}, {self.high}]")
+
+    def from_unit(self, u):
+        n = self.high - self.low + 1
+        idx = jnp.clip(jnp.floor(u * n), 0, n - 1)
+        return self.low + idx
+
+    def to_unit(self, v):
+        n = self.high - self.low + 1
+        # centre of the bucket, so from_unit(to_unit(v)) == v
+        return ((v - self.low) + 0.5) / n
+
+    def materialize(self, v):
+        return int(v)
+
+    @property
+    def discrete(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class Choice(Domain):
+    options: tuple
+
+    def __init__(self, options: Sequence[Any]):
+        object.__setattr__(self, "options", tuple(options))
+
+    def from_unit(self, u):
+        n = len(self.options)
+        return jnp.clip(jnp.floor(u * n), 0, n - 1)
+
+    def to_unit(self, v):
+        return (v + 0.5) / len(self.options)
+
+    def materialize(self, v):
+        return self.options[int(v)]
+
+    @property
+    def discrete(self):
+        return True
+
+
+class SearchSpace:
+    """An ordered mapping name -> Domain with vectorized sampling.
+
+    The canonical array layout is ``float32[..., d]`` in unit-cube
+    coordinates, with dimension order = insertion order of ``domains``.
+    """
+
+    def __init__(self, domains: Mapping[str, Domain]):
+        self.domains = dict(domains)
+        self.names = list(self.domains.keys())
+
+    @property
+    def dim(self) -> int:
+        return len(self.names)
+
+    # -- sampling ---------------------------------------------------------
+
+    def sample_unit(self, key: jax.Array, n: int) -> jax.Array:
+        """Uniform sample in the unit cube: ``float32[n, d]``."""
+        return jax.random.uniform(key, (n, self.dim), dtype=jnp.float32)
+
+    def from_unit(self, u: jax.Array) -> dict[str, jax.Array]:
+        """Unit-cube array ``[..., d]`` -> dict of typed value arrays.
+
+        Jittable; used on-device to turn a population matrix into the
+        per-member hyperparameter arrays fed to the train step.
+        """
+        return {
+            name: dom.from_unit(u[..., i])
+            for i, (name, dom) in enumerate(self.domains.items())
+        }
+
+    def to_unit(self, values: Mapping[str, jax.Array]) -> jax.Array:
+        """Dict of value arrays -> unit-cube array ``[..., d]``."""
+        cols = [
+            self.domains[name].to_unit(jnp.asarray(values[name], jnp.float32))
+            for name in self.names
+        ]
+        return jnp.stack(cols, axis=-1)
+
+    def sample(self, key: jax.Array, n: int) -> dict[str, jax.Array]:
+        """Sample n points, returned as typed value arrays."""
+        return self.from_unit(self.sample_unit(key, n))
+
+    # -- host-side edges --------------------------------------------------
+
+    def materialize_row(self, u_row: np.ndarray) -> dict[str, Any]:
+        """One unit-cube row -> a plain-Python hparam dict (host side)."""
+        out = {}
+        for i, (name, dom) in enumerate(self.domains.items()):
+            v = np.asarray(dom.from_unit(jnp.asarray(u_row[i])))
+            out[name] = dom.materialize(v)
+        return out
+
+    def discrete_mask(self) -> np.ndarray:
+        """bool[d]: which dims are discrete (used by TPE/PBT perturbation)."""
+        return np.array([d.discrete for d in self.domains.values()])
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={v}" for k, v in self.domains.items())
+        return f"SearchSpace({inner})"

@@ -1,0 +1,51 @@
+"""Trial bookkeeping shared by the host-side driver and backends.
+
+In the reference, a "trial" is the unit of work sent from the Coordinator
+to an MPIWorker rank (SURVEY.md §1; reference unreadable). Here a Trial
+is a host-side record; on the TPU backend an entire population of trials
+lives on-device as one unit-cube matrix and these records are only the
+host-visible ledger.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+
+class TrialStatus(enum.Enum):
+    PENDING = "pending"
+    RUNNING = "running"
+    PAUSED = "paused"  # ASHA: waiting at a rung for promotion decision
+    STOPPED = "stopped"  # early-stopped (ASHA cut / PBT replaced)
+    DONE = "done"
+
+
+@dataclasses.dataclass
+class Trial:
+    trial_id: int
+    params: dict[str, Any]  # typed values (host view)
+    unit: np.ndarray  # unit-cube row, the canonical representation
+    budget: int = 0  # steps/epochs granted so far (ASHA rung budget)
+    rung: int = 0  # current ASHA rung
+    status: TrialStatus = TrialStatus.PENDING
+    score: Optional[float] = None  # best/latest objective value
+    history: list = dataclasses.field(default_factory=list)
+    created_at: float = dataclasses.field(default_factory=time.time)
+
+    def record(self, score: float, step: int) -> None:
+        self.score = float(score)
+        self.history.append((int(step), float(score)))
+
+
+@dataclasses.dataclass
+class TrialResult:
+    trial_id: int
+    score: float
+    step: int
+    wall_time: float = 0.0
+    extra: dict = dataclasses.field(default_factory=dict)

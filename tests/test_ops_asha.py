@@ -1,0 +1,40 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_opt_tpu.ops import asha_cut, asha_rungs
+
+
+def test_rung_ladder():
+    assert asha_rungs(1, 81, 3) == [1, 3, 9, 27, 81]
+    assert asha_rungs(2, 20, 4) == [2, 8, 20]
+    with pytest.raises(ValueError):
+        asha_rungs(0, 10, 3)
+
+
+def test_cut_promotes_top_fraction():
+    scores = jnp.array([0.1, 0.9, 0.5, 0.8, 0.2, 0.7, 0.3, 0.6])
+    promote, order = asha_cut(scores, eta=4)
+    # ceil(8/4)=2 survivors: the 0.9 and 0.8 entries
+    assert int(promote.sum()) == 2
+    assert bool(promote[1]) and bool(promote[3])
+    np.testing.assert_array_equal(np.asarray(order[:2]), [1, 3])
+
+
+def test_cut_respects_valid_mask():
+    scores = jnp.array([0.9, 0.8, 0.7, 0.1])
+    valid = jnp.array([False, True, True, True])
+    promote, _ = asha_cut(scores, eta=3, valid=valid)
+    # ceil(3/3)=1 survivor among valid entries: index 1 (0.8)
+    assert int(promote.sum()) == 1
+    assert bool(promote[1])
+    assert not bool(promote[0])
+
+
+def test_cut_is_jittable():
+    f = jax.jit(asha_cut, static_argnames="eta")
+    promote, _ = f(jnp.arange(9.0), eta=3)
+    assert int(promote.sum()) == 3
+    # the top third are indices 6,7,8
+    assert bool(promote[6]) and bool(promote[7]) and bool(promote[8])

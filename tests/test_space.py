@@ -1,0 +1,70 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_opt_tpu import Choice, IntUniform, LogUniform, SearchSpace, Uniform
+
+
+@pytest.fixture
+def space():
+    return SearchSpace(
+        {
+            "lr": LogUniform(1e-4, 1e-1),
+            "momentum": Uniform(0.5, 0.99),
+            "layers": IntUniform(1, 4),
+            "act": Choice(["relu", "tanh", "gelu"]),
+        }
+    )
+
+
+def test_sample_shapes_and_ranges(space):
+    key = jax.random.key(0)
+    u = space.sample_unit(key, 100)
+    assert u.shape == (100, 4)
+    vals = space.from_unit(u)
+    assert vals["lr"].shape == (100,)
+    assert jnp.all(vals["lr"] >= 1e-4) and jnp.all(vals["lr"] <= 1e-1)
+    assert jnp.all(vals["momentum"] >= 0.5) and jnp.all(vals["momentum"] <= 0.99)
+    assert jnp.all(vals["layers"] >= 1) and jnp.all(vals["layers"] <= 4)
+    assert jnp.all(vals["act"] >= 0) and jnp.all(vals["act"] <= 2)
+
+
+def test_unit_roundtrip_continuous(space):
+    key = jax.random.key(1)
+    u = space.sample_unit(key, 50)
+    vals = space.from_unit(u)
+    u2 = space.to_unit(vals)
+    # continuous dims roundtrip exactly (within float tolerance)
+    np.testing.assert_allclose(u[:, 0], u2[:, 0], atol=1e-5)
+    np.testing.assert_allclose(u[:, 1], u2[:, 1], atol=1e-5)
+    # discrete dims roundtrip to the same bucket
+    vals2 = space.from_unit(u2)
+    np.testing.assert_array_equal(np.asarray(vals["layers"]), np.asarray(vals2["layers"]))
+    np.testing.assert_array_equal(np.asarray(vals["act"]), np.asarray(vals2["act"]))
+
+
+def test_loguniform_is_log_spaced(space):
+    key = jax.random.key(2)
+    vals = space.sample(key, 4000)
+    lr = np.asarray(vals["lr"])
+    # median of a log-uniform over [1e-4, 1e-1] is 10^-2.5
+    assert 10**-2.8 < np.median(lr) < 10**-2.2
+
+
+def test_materialize_row(space):
+    row = np.array([0.5, 0.5, 0.5, 0.9])
+    h = space.materialize_row(row)
+    assert isinstance(h["lr"], float)
+    assert isinstance(h["layers"], int)
+    assert h["act"] == "gelu"
+
+
+def test_discrete_mask(space):
+    np.testing.assert_array_equal(space.discrete_mask(), [False, False, True, True])
+
+
+def test_from_unit_is_jittable(space):
+    f = jax.jit(space.from_unit)
+    out = f(space.sample_unit(jax.random.key(3), 8))
+    assert out["lr"].shape == (8,)
