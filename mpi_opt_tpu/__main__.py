@@ -1,0 +1,3 @@
+from mpi_opt_tpu.cli import main
+
+raise SystemExit(main())

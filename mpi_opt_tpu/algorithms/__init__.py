@@ -1,0 +1,30 @@
+"""Algorithm registry (SURVEY.md §2 rows 3-6).
+
+The registry mirrors the reference's named-algorithm selection on its
+CLI (SURVEY.md §1 CLI layer; reference unreadable).
+"""
+
+from mpi_opt_tpu.algorithms.asha import ASHA
+from mpi_opt_tpu.algorithms.base import Algorithm
+from mpi_opt_tpu.algorithms.pbt import PBT
+from mpi_opt_tpu.algorithms.random_search import RandomSearch
+from mpi_opt_tpu.algorithms.tpe import TPE
+
+ALGORITHMS: dict[str, type[Algorithm]] = {
+    RandomSearch.name: RandomSearch,
+    ASHA.name: ASHA,
+    PBT.name: PBT,
+    TPE.name: TPE,
+}
+
+
+def get_algorithm(name: str) -> type[Algorithm]:
+    try:
+        return ALGORITHMS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown algorithm {name!r}; available: {sorted(ALGORITHMS)}"
+        ) from None
+
+
+__all__ = ["Algorithm", "RandomSearch", "ASHA", "PBT", "TPE", "ALGORITHMS", "get_algorithm"]

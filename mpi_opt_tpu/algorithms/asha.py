@@ -1,0 +1,138 @@
+"""Asynchronous Successive Halving (ASHA), host-side bookkeeping.
+
+Reference behavior (SURVEY.md §2 row 4; reference unreadable): trials
+start at the lowest budget rung; when a trial finishes a rung, it is
+promoted to the next rung if it ranks in the top 1/eta of all scores
+recorded at that rung so far, otherwise it is stopped — asynchronously,
+without waiting for the rung to fill (the reference coordinates this
+with MPI messages between coordinator and ranks).
+
+Here the promotion rule is evaluated on the host over numpy arrays
+(scores at a rung are tiny); the *synchronous* population-wide variant
+used inside the TPU backend's on-device generation loop uses
+``mpi_opt_tpu.ops.asha_cut`` instead. Budgets are cumulative: a promoted
+trial's ``budget`` is the next rung's total step count, and stateful
+backends resume from the trial's saved state rather than retraining.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+
+from mpi_opt_tpu.algorithms.base import Algorithm
+from mpi_opt_tpu.ops.asha import asha_rungs
+from mpi_opt_tpu.space import SearchSpace
+from mpi_opt_tpu.trial import TrialResult, TrialStatus
+
+
+class ASHA(Algorithm):
+    name = "asha"
+
+    def __init__(
+        self,
+        space: SearchSpace,
+        seed: int = 0,
+        max_trials: int = 64,
+        min_budget: int = 1,
+        max_budget: int = 27,
+        eta: int = 3,
+    ):
+        super().__init__(space, seed)
+        self.max_trials = max_trials
+        self.eta = eta
+        self.rungs = asha_rungs(min_budget, max_budget, eta)
+        # scores recorded per rung: rung index -> {trial_id: score}
+        self.rung_scores: list[dict[int, float]] = [dict() for _ in self.rungs]
+        self._suggested = 0
+        self._promotable: list[int] = []  # trial ids awaiting their next rung
+        self._outstanding: set[int] = set()
+        self._requeue: list[int] = []  # in-flight trials recovered from a checkpoint
+
+    # -- contract ---------------------------------------------------------
+
+    def next_batch(self, n):
+        out = []
+        # trials whose results were lost to a checkpoint/restore cycle
+        # get re-dispatched before anything else
+        while self._requeue and len(out) < n:
+            tid = self._requeue.pop(0)
+            t = self.trials[tid]
+            t.status = TrialStatus.RUNNING
+            out.append(t)
+        # continuing trials next: they free memory sooner and drive the
+        # search deeper (same priority the async rule gives promotions)
+        while self._promotable and len(out) < n:
+            tid = self._promotable.pop(0)
+            t = self.trials[tid]
+            t.status = TrialStatus.RUNNING
+            out.append(t)
+        while len(out) < n and self._suggested < self.max_trials:
+            key = jax.random.fold_in(jax.random.key(self.seed), self._suggested)
+            unit = np.asarray(self.space.sample_unit(key, 1))[0]
+            t = self._new_trial(unit, budget=self.rungs[0])
+            t.status = TrialStatus.RUNNING
+            out.append(t)
+            self._suggested += 1
+        self._outstanding.update(t.trial_id for t in out)
+        return out
+
+    def report_batch(self, results: Sequence[TrialResult]):
+        for r in results:
+            t = self.trials[r.trial_id]
+            self._outstanding.discard(r.trial_id)
+            t.record(r.score, r.step)
+            rung = t.rung
+            self.rung_scores[rung][t.trial_id] = float(r.score)
+            if rung == len(self.rungs) - 1:
+                t.status = TrialStatus.DONE
+                continue
+            if self._promotes(rung, r.score):
+                t.rung = rung + 1
+                t.budget = self.rungs[t.rung]
+                t.status = TrialStatus.PAUSED
+                self._promotable.append(t.trial_id)
+            else:
+                t.status = TrialStatus.STOPPED
+
+    def finished(self):
+        no_new = self._suggested >= self.max_trials
+        return (
+            no_new and not self._promotable and not self._outstanding and not self._requeue
+        )
+
+    # -- promotion rule ---------------------------------------------------
+
+    def _promotes(self, rung: int, score: float) -> bool:
+        """Async rule: in the top 1/eta of scores recorded at this rung."""
+        scores = np.array(list(self.rung_scores[rung].values()))
+        k = max(1, int(np.ceil(len(scores) / self.eta)))
+        # count of strictly-better scores < k  =>  within top-k
+        return int((scores > score).sum()) < k
+
+    # -- checkpoint -------------------------------------------------------
+
+    def state_dict(self):
+        d = super().state_dict()
+        d["asha"] = {
+            "suggested": self._suggested,
+            "promotable": list(self._promotable),
+            "outstanding": sorted(self._outstanding | set(self._requeue)),
+            "rung_scores": [dict(r) for r in self.rung_scores],
+        }
+        return d
+
+    def load_state_dict(self, state):
+        super().load_state_dict(state)
+        a = state["asha"]
+        self._suggested = a["suggested"]
+        self._promotable = list(a["promotable"])
+        self.rung_scores = [
+            {int(k): v for k, v in r.items()} for r in a["rung_scores"]
+        ]
+        self._outstanding = set()
+        # results for in-flight trials died with the old process;
+        # re-dispatch them rather than dropping them as RUNNING forever
+        self._requeue = [int(t) for t in a.get("outstanding", [])]

@@ -1,0 +1,121 @@
+"""Host-side algorithm interface: the suggest→evaluate→report contract.
+
+Reference parity (SURVEY.md §1, §3; reference unreadable — contract from
+BASELINE.json): the reference's search driver runs a suggest→evaluate→
+report loop over pluggable algorithms; its Coordinator dispatches
+suggested trials to MPIWorker ranks and feeds results back.
+
+Design difference: our API is *pull-based* — the driver asks the
+algorithm for the next batch of trials sized to the backend's capacity
+(`next_batch(n)`), instead of the coordinator pushing one trial per idle
+rank. This shape serves the TPU backend, whose natural unit of work is a
+whole vmapped population, while degrading gracefully to n=1 for serial
+CPU evaluation. The decision *math* for ASHA/PBT/TPE lives in
+``mpi_opt_tpu.ops`` as jittable kernels; these classes own bookkeeping
+only, so the same kernels serve both the host loop and the fully
+on-device loop.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional, Sequence
+
+import numpy as np
+
+from mpi_opt_tpu.space import SearchSpace
+from mpi_opt_tpu.trial import Trial, TrialResult, TrialStatus
+
+
+class Algorithm(abc.ABC):
+    """Base class for search algorithms.
+
+    Score convention: HIGHER IS BETTER. Drivers translate minimization
+    problems by negating the objective before reporting.
+    """
+
+    name: str = "base"
+
+    def __init__(self, space: SearchSpace, seed: int = 0):
+        self.space = space
+        self.seed = seed
+        self.trials: dict[int, Trial] = {}
+        self._next_id = 0
+
+    # -- core contract ----------------------------------------------------
+
+    @abc.abstractmethod
+    def next_batch(self, n: int) -> list[Trial]:
+        """Up to ``n`` trials to evaluate next (new or continuing).
+
+        May return fewer (e.g. budget exhausted, or a generational
+        algorithm mid-generation). Empty list + ``not finished()`` means
+        "waiting on outstanding results".
+        """
+
+    @abc.abstractmethod
+    def report_batch(self, results: Sequence[TrialResult]) -> None:
+        """Record completed evaluations and update search state."""
+
+    @abc.abstractmethod
+    def finished(self) -> bool:
+        """True when the search has no more work to hand out."""
+
+    # -- shared bookkeeping ----------------------------------------------
+
+    def _new_trial(self, unit_row: np.ndarray, budget: int = 0) -> Trial:
+        t = Trial(
+            trial_id=self._next_id,
+            params=self.space.materialize_row(np.asarray(unit_row)),
+            unit=np.asarray(unit_row, dtype=np.float32),
+            budget=budget,
+        )
+        self._next_id += 1
+        self.trials[t.trial_id] = t
+        return t
+
+    def best(self) -> Optional[Trial]:
+        scored = [t for t in self.trials.values() if t.score is not None]
+        return max(scored, key=lambda t: t.score) if scored else None
+
+    @property
+    def n_trials(self) -> int:
+        return len(self.trials)
+
+    # -- checkpoint/resume (SURVEY.md §2 row 13) -------------------------
+
+    def state_dict(self) -> dict:
+        return {
+            "next_id": self._next_id,
+            "seed": self.seed,
+            "trials": [
+                {
+                    "trial_id": t.trial_id,
+                    "unit": t.unit.tolist(),
+                    "budget": t.budget,
+                    "rung": t.rung,
+                    "status": t.status.value,
+                    "score": t.score,
+                    "history": t.history,
+                }
+                for t in self.trials.values()
+            ],
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self._next_id = state["next_id"]
+        self.seed = state["seed"]
+        self.trials = {}
+        for rec in state["trials"]:
+            unit = np.asarray(rec["unit"], dtype=np.float32)
+            t = Trial(
+                trial_id=rec["trial_id"],
+                params=self.space.materialize_row(unit),
+                unit=unit,
+                budget=rec["budget"],
+                rung=rec["rung"],
+                status=TrialStatus(rec["status"]),
+            )
+            t.score = rec["score"]
+            t.history = [tuple(h) for h in rec["history"]]
+            self.trials[t.trial_id] = t

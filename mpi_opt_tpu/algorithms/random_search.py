@@ -1,0 +1,57 @@
+"""Random search (SURVEY.md §2 row 3): i.i.d. sampling over the space."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+
+from mpi_opt_tpu.algorithms.base import Algorithm
+from mpi_opt_tpu.space import SearchSpace
+from mpi_opt_tpu.trial import TrialResult, TrialStatus
+
+
+class RandomSearch(Algorithm):
+    name = "random"
+
+    def __init__(self, space: SearchSpace, seed: int = 0, max_trials: int = 16, budget: int = 1):
+        super().__init__(space, seed)
+        self.max_trials = max_trials
+        self.budget = budget  # steps/epochs per trial, passed to the backend
+        self._suggested = 0
+        self._done = 0
+
+    def next_batch(self, n):
+        take = min(n, self.max_trials - self._suggested)
+        if take <= 0:
+            return []
+        key = jax.random.fold_in(jax.random.key(self.seed), self._suggested)
+        unit = np.asarray(self.space.sample_unit(key, take))
+        out = []
+        for i in range(take):
+            t = self._new_trial(unit[i], budget=self.budget)
+            t.status = TrialStatus.RUNNING
+            out.append(t)
+        self._suggested += take
+        return out
+
+    def report_batch(self, results: Sequence[TrialResult]):
+        for r in results:
+            t = self.trials[r.trial_id]
+            t.record(r.score, r.step)
+            t.status = TrialStatus.DONE
+            self._done += 1
+
+    def finished(self):
+        return self._done >= self.max_trials
+
+    def state_dict(self):
+        d = super().state_dict()
+        d["random"] = {"suggested": self._suggested, "done": self._done}
+        return d
+
+    def load_state_dict(self, state):
+        super().load_state_dict(state)
+        self._suggested = state["random"]["suggested"]
+        self._done = state["random"]["done"]

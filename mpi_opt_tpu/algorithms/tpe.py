@@ -1,0 +1,117 @@
+"""TPE host wrapper around the vectorized acquisition kernel.
+
+Reference behavior (SURVEY.md §2 row 6; reference unreadable): suggest
+points maximizing l(x)/g(x) over Parzen estimators of good/bad trials.
+
+The math lives in ``mpi_opt_tpu.ops.tpe.tpe_suggest`` (fixed-shape ring
+buffer, batched candidate scoring). This class owns the buffer and the
+trial ledger; the kernel is jitted once and reused for the whole search
+regardless of how much history accumulates.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+
+from mpi_opt_tpu.algorithms.base import Algorithm
+from mpi_opt_tpu.ops.tpe import TPEConfig, tpe_suggest
+from mpi_opt_tpu.space import SearchSpace
+from mpi_opt_tpu.trial import TrialResult, TrialStatus
+
+
+class TPE(Algorithm):
+    name = "tpe"
+
+    def __init__(
+        self,
+        space: SearchSpace,
+        seed: int = 0,
+        max_trials: int = 64,
+        budget: int = 1,
+        n_startup: int = 10,  # pure-random warmup before the surrogate kicks in
+        buffer_size: int = 512,
+        config: TPEConfig = TPEConfig(),
+    ):
+        super().__init__(space, seed)
+        self.max_trials = max_trials
+        self.budget = budget
+        self.n_startup = n_startup
+        self.config = config
+        self.buffer_size = buffer_size
+        self._obs_unit = np.zeros((buffer_size, space.dim), dtype=np.float32)
+        self._obs_score = np.zeros(buffer_size, dtype=np.float32)
+        self._valid = np.zeros(buffer_size, dtype=bool)
+        self._n_obs = 0
+        self._suggested = 0
+        self._done = 0
+        self._suggest_fn = jax.jit(tpe_suggest, static_argnames=("n_suggest", "cfg"))
+
+    def next_batch(self, n):
+        take = min(n, self.max_trials - self._suggested)
+        if take <= 0:
+            return []
+        key = jax.random.fold_in(jax.random.key(self.seed), self._suggested)
+        if self._n_obs < self.n_startup:
+            unit = np.asarray(self.space.sample_unit(key, take))
+        else:
+            # round n_suggest up to a power of two so varying batch
+            # remainders hit at most log2(capacity) compiled variants
+            block = 1 << (take - 1).bit_length()
+            sugg, _ = self._suggest_fn(
+                key,
+                self._obs_unit,
+                self._obs_score,
+                self._valid,
+                n_suggest=min(block, self.config.n_candidates),
+                cfg=self.config,
+            )
+            unit = np.asarray(sugg[:take])
+        out = []
+        for i in range(take):
+            t = self._new_trial(unit[i], budget=self.budget)
+            t.status = TrialStatus.RUNNING
+            out.append(t)
+        self._suggested += take
+        return out
+
+    def report_batch(self, results: Sequence[TrialResult]):
+        for r in results:
+            t = self.trials[r.trial_id]
+            t.record(r.score, r.step)
+            t.status = TrialStatus.DONE
+            slot = self._n_obs % self.buffer_size
+            self._obs_unit[slot] = t.unit
+            self._obs_score[slot] = r.score
+            self._valid[slot] = True
+            self._n_obs += 1
+            self._done += 1
+
+    def finished(self):
+        return self._done >= self.max_trials
+
+    # -- checkpoint -------------------------------------------------------
+
+    def state_dict(self):
+        d = super().state_dict()
+        d["tpe"] = {
+            "obs_unit": self._obs_unit.tolist(),
+            "obs_score": self._obs_score.tolist(),
+            "valid": self._valid.tolist(),
+            "n_obs": self._n_obs,
+            "suggested": self._suggested,
+            "done": self._done,
+        }
+        return d
+
+    def load_state_dict(self, state):
+        super().load_state_dict(state)
+        t = state["tpe"]
+        self._obs_unit = np.asarray(t["obs_unit"], dtype=np.float32)
+        self._obs_score = np.asarray(t["obs_score"], dtype=np.float32)
+        self._valid = np.asarray(t["valid"], dtype=bool)
+        self._n_obs = t["n_obs"]
+        self._suggested = t["suggested"]
+        self._done = t["done"]

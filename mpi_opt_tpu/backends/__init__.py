@@ -1,0 +1,26 @@
+"""Backend registry — ``backend=`` plugin hook (BASELINE.json north_star)."""
+
+from mpi_opt_tpu.backends.base import (
+    Backend,
+    available_backends,
+    get_backend,
+    register_backend,
+)
+
+# registration side effects
+from mpi_opt_tpu.backends import cpu  # noqa: E402,F401
+
+# The TPU backend imports lazily from get_backend to keep CPU-only usage
+# light; importing mpi_opt_tpu.backends.tpu pulls in flax.
+
+
+def _register_lazy():
+    try:
+        from mpi_opt_tpu.backends import tpu  # noqa: F401
+    except ImportError:
+        pass
+
+
+_register_lazy()
+
+__all__ = ["Backend", "get_backend", "register_backend", "available_backends"]

@@ -1,0 +1,62 @@
+"""Execution-backend plugin layer (SURVEY.md §2 rows 1, 7-9).
+
+Reference contract (from BASELINE.json north_star): a ``backend=``
+plugin hook through which the search driver evaluates suggested trials;
+the CPU path is the default, TPU opt-in via ``--backend=tpu``.
+
+A backend owns the mapping from host-side Trial records to actual
+training work. ``capacity`` tells the driver how many trials to request
+per batch — the TPU backend reports its whole population size so the
+driver naturally feeds it device-shaped batches.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Sequence
+
+from mpi_opt_tpu.trial import Trial, TrialResult
+from mpi_opt_tpu.workloads.base import Workload
+
+_BACKENDS: dict[str, type] = {}
+
+
+def register_backend(cls):
+    _BACKENDS[cls.name] = cls
+    return cls
+
+
+def get_backend(name: str, workload: Workload, **kwargs) -> "Backend":
+    try:
+        cls = _BACKENDS[name]
+    except KeyError:
+        raise ValueError(f"unknown backend {name!r}; available: {sorted(_BACKENDS)}") from None
+    return cls(workload, **kwargs)
+
+
+def available_backends() -> list[str]:
+    return sorted(_BACKENDS)
+
+
+class Backend(abc.ABC):
+    name: str = "base"
+
+    def __init__(self, workload: Workload):
+        self.workload = workload
+
+    @property
+    @abc.abstractmethod
+    def capacity(self) -> int:
+        """Preferred evaluation batch size."""
+
+    @abc.abstractmethod
+    def evaluate(self, trials: Sequence[Trial]) -> list[TrialResult]:
+        """Run each trial to its budget; return scores.
+
+        Trials may carry ``params['__inherit_from__']`` (PBT weight
+        inheritance) and cumulative budgets (ASHA promotions); stateful
+        backends honor both, stateless backends retrain from scratch.
+        """
+
+    def close(self) -> None:
+        pass

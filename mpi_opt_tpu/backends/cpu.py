@@ -1,0 +1,155 @@
+"""Default CPU backend: process-parallel trial evaluation.
+
+Reference parity (SURVEY.md §1-§3; reference unreadable): the
+reference's default path evaluates trials on MPI ranks — a Coordinator
+sends hyperparameters to MPIWorker processes, which train and report a
+score. This container has no MPI, so rank-parallelism is rebuilt on
+``multiprocessing`` (same process-per-trial execution model, same
+role as the 8-rank MPI baseline in BASELINE.json's north star — and the
+measured baseline that bench.py compares the TPU backend against).
+
+Two paths:
+- stateless (random/TPE/ASHA from-scratch): trials fan out to a process
+  pool; the workload is reconstructed in each worker by registry name so
+  nothing unpicklable crosses the fork.
+- stateful (PBT inheritance / ASHA warm resume): states are kept in the
+  parent and training runs in-process — correct but sequential;
+  the TPU population backend is the fast path for these.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import time
+from collections import OrderedDict
+from typing import Any, Sequence
+
+from mpi_opt_tpu.backends.base import Backend, register_backend
+from mpi_opt_tpu.trial import Trial, TrialResult
+from mpi_opt_tpu.workloads.base import Workload
+
+_WORKER_WORKLOAD: Workload | None = None
+
+
+def _init_worker(workload_name: str, workload_kwargs: dict):
+    global _WORKER_WORKLOAD
+    from mpi_opt_tpu.workloads import get_workload
+
+    _WORKER_WORKLOAD = get_workload(workload_name, **workload_kwargs)
+
+
+def _init_pool_worker(workload_name: str, workload_kwargs: dict):
+    """Pool-process initializer (never runs in the parent).
+
+    CPU workers must never grab the TPU: the parent may hold it, and N
+    spawned children racing to initialize the TPU platform would hang.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _init_worker(workload_name, workload_kwargs)
+
+
+def _eval_one(args):
+    trial_id, params, budget, seed = args
+    t0 = time.perf_counter()
+    score = _WORKER_WORKLOAD.evaluate(params, budget, seed)
+    return TrialResult(
+        trial_id=trial_id,
+        score=float(score),
+        step=budget,
+        wall_time=time.perf_counter() - t0,
+    )
+
+
+@register_backend
+class CPUBackend(Backend):
+    name = "cpu"
+
+    def __init__(
+        self,
+        workload: Workload,
+        n_workers: int = 0,  # 0 -> os.cpu_count()
+        seed: int = 0,
+        workload_kwargs: dict | None = None,
+        max_states: int = 256,
+    ):
+        super().__init__(workload)
+        self.n_workers = n_workers or (os.cpu_count() or 1)
+        self.seed = seed
+        self._workload_kwargs = workload_kwargs or {}
+        self._pool = None
+        # trial_id -> training state, FIFO-bounded: PBT mints fresh trial
+        # ids every generation and would otherwise accumulate every
+        # generation's model states until OOM (inheritance only ever
+        # reaches one generation back; ASHA resumes are also recent)
+        self.max_states = max_states
+        self._states: "OrderedDict[int, Any]" = OrderedDict()
+        self._trained: dict[int, int] = {}  # trial_id -> steps completed
+
+    @property
+    def capacity(self) -> int:
+        return self.n_workers
+
+    def _get_pool(self):
+        if self._pool is None:
+            # spawn, not fork: the parent has live JAX threads and forking
+            # a multithreaded process risks deadlock in the children
+            ctx = mp.get_context("spawn")
+            self._pool = ctx.Pool(
+                self.n_workers,
+                initializer=_init_pool_worker,
+                initargs=(self.workload.name, self._workload_kwargs),
+            )
+        return self._pool
+
+    def evaluate(self, trials: Sequence[Trial]) -> list[TrialResult]:
+        if self.workload.stateful:
+            # stateful path: warm resumes + PBT inheritance need the
+            # state store, which lives in this process
+            return [self._evaluate_stateful(t) for t in trials]
+        jobs = [
+            (t.trial_id, _clean(t.params), t.budget, self.seed) for t in trials
+        ]
+        if self.n_workers == 1 or len(jobs) == 1:
+            _init_worker(self.workload.name, self._workload_kwargs)
+            return [_eval_one(j) for j in jobs]
+        return list(self._get_pool().map(_eval_one, jobs))
+
+    def _evaluate_stateful(self, t: Trial) -> TrialResult:
+        t0 = time.perf_counter()
+        params = _clean(t.params)
+        src = t.params.get("__inherit_from__")
+        if src is not None and src in self._states:
+            state = self._states[src]
+            done = self._trained.get(src, 0)
+        elif t.trial_id in self._states:
+            state = self._states[t.trial_id]
+            done = self._trained[t.trial_id]
+        else:
+            state = self.workload.init_state(params, self.seed)
+            done = 0
+        remaining = max(0, t.budget - done)
+        state, score = self.workload.train(state, params, remaining, self.seed)
+        self._states[t.trial_id] = state
+        self._states.move_to_end(t.trial_id)
+        self._trained[t.trial_id] = t.budget
+        while len(self._states) > self.max_states:
+            old, _ = self._states.popitem(last=False)
+            self._trained.pop(old, None)
+        return TrialResult(
+            trial_id=t.trial_id,
+            score=float(score),
+            step=t.budget,
+            wall_time=time.perf_counter() - t0,
+        )
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool.join()
+            self._pool = None
+
+
+def _clean(params: dict) -> dict:
+    """Strip framework-internal keys before handing params to workloads."""
+    return {k: v for k, v in params.items() if not k.startswith("__")}

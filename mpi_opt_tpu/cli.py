@@ -1,0 +1,118 @@
+"""CLI + config system (SURVEY.md §2 row 1).
+
+Reference contract (BASELINE.json north_star): named algorithm
+selection, ``--backend=tpu`` opt-in with the CPU path as default,
+population/trial counts, workload selection.
+
+Example (config 1, the minimum end-to-end slice):
+    python -m mpi_opt_tpu --workload digits --algorithm random \
+        --trials 16 --budget 100 --backend cpu --workers 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from mpi_opt_tpu.algorithms import ALGORITHMS, get_algorithm
+from mpi_opt_tpu.backends import available_backends, get_backend
+from mpi_opt_tpu.driver import run_search
+from mpi_opt_tpu.ops.pbt import PBTConfig
+from mpi_opt_tpu.utils.metrics import stdout_logger
+from mpi_opt_tpu.workloads import available, get_workload
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi_opt_tpu",
+        description="TPU-native hyperparameter optimization",
+    )
+    p.add_argument("--workload", required=True, choices=available())
+    p.add_argument("--algorithm", default="random", choices=sorted(ALGORITHMS))
+    p.add_argument(
+        "--backend",
+        default="cpu",
+        choices=available_backends(),
+        help="execution backend (cpu is the default path; tpu is opt-in)",
+    )
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--trials", type=int, default=16, help="total trials (random/tpe/asha)")
+    p.add_argument("--budget", type=int, default=100, help="steps per trial (random/tpe)")
+    p.add_argument("--workers", type=int, default=0, help="cpu backend: processes (0=auto)")
+    p.add_argument("--metrics-file", default=None, help="JSONL metrics output path")
+    # ASHA
+    p.add_argument("--min-budget", type=int, default=10)
+    p.add_argument("--max-budget", type=int, default=270)
+    p.add_argument("--eta", type=int, default=3)
+    # PBT
+    p.add_argument("--population", type=int, default=32)
+    p.add_argument("--generations", type=int, default=10)
+    p.add_argument("--steps-per-generation", type=int, default=200)
+    p.add_argument("--truncation", type=float, default=0.25)
+    return p
+
+
+def make_algorithm(args, space):
+    cls = get_algorithm(args.algorithm)
+    if args.algorithm == "random":
+        return cls(space, seed=args.seed, max_trials=args.trials, budget=args.budget)
+    if args.algorithm == "tpe":
+        return cls(space, seed=args.seed, max_trials=args.trials, budget=args.budget)
+    if args.algorithm == "asha":
+        return cls(
+            space,
+            seed=args.seed,
+            max_trials=args.trials,
+            min_budget=args.min_budget,
+            max_budget=args.max_budget,
+            eta=args.eta,
+        )
+    if args.algorithm == "pbt":
+        return cls(
+            space,
+            seed=args.seed,
+            population=args.population,
+            generations=args.generations,
+            steps_per_generation=args.steps_per_generation,
+            config=PBTConfig(truncation_frac=args.truncation),
+        )
+    raise AssertionError(args.algorithm)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    workload = get_workload(args.workload)
+    space = workload.default_space()
+    algorithm = make_algorithm(args, space)
+    backend_kwargs = {}
+    if args.backend == "cpu":
+        backend_kwargs = {"n_workers": args.workers, "seed": args.seed}
+    elif args.backend == "tpu":
+        backend_kwargs = {"population": args.population, "seed": args.seed}
+    backend = get_backend(args.backend, workload, **backend_kwargs)
+    metrics = stdout_logger(path=args.metrics_file)
+    try:
+        result = run_search(algorithm, backend, metrics=metrics)
+    finally:
+        backend.close()
+    best = result.best
+    summary = {
+        "workload": args.workload,
+        "algorithm": args.algorithm,
+        "backend": args.backend,
+        "n_trials": result.n_trials,
+        "wall_s": round(result.wall_s, 3),
+        "trials_per_sec_per_chip": round(result.trials_per_sec_per_chip, 4),
+        "best_score": None if best is None else round(best.score, 6),
+        "best_params": None
+        if best is None
+        else {k: v for k, v in best.params.items() if not k.startswith("__")},
+    }
+    metrics.summary(**{"final": True})
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,72 @@
+"""The search driver: suggest → evaluate → report (SURVEY.md §3).
+
+Reference call stack (contract from BASELINE.json; reference
+unreadable): CLI → driver loop { algorithm.suggest → backend.evaluate
+(Coordinator → MPI → MPIWorker ranks) → collect scores → algorithm
+.report }. Here the loop is identical in shape, but the batch size is
+pulled from the backend (``capacity``) so a TPU population backend
+receives device-shaped batches, and a generational algorithm (PBT) can
+hold the loop between generations without extra driver modes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from mpi_opt_tpu.algorithms.base import Algorithm
+from mpi_opt_tpu.backends.base import Backend
+from mpi_opt_tpu.trial import Trial
+from mpi_opt_tpu.utils.metrics import MetricsLogger, null_logger
+
+
+@dataclasses.dataclass
+class SearchResult:
+    best: Optional[Trial]
+    n_trials: int
+    wall_s: float
+    trials_per_sec_per_chip: float
+
+
+def run_search(
+    algorithm: Algorithm,
+    backend: Backend,
+    metrics: Optional[MetricsLogger] = None,
+    max_batches: Optional[int] = None,
+) -> SearchResult:
+    metrics = metrics or null_logger()
+    t0 = time.perf_counter()
+    batches = 0
+    n_run = 0  # trials evaluated by THIS run (metrics may be shared/reused)
+    while not algorithm.finished():
+        batch = algorithm.next_batch(backend.capacity)
+        if not batch:
+            if algorithm.finished():
+                break
+            raise RuntimeError(
+                f"{algorithm.name}: no trials to run but search not finished "
+                "(algorithm is waiting on results that were never reported)"
+            )
+        results = backend.evaluate(batch)
+        algorithm.report_batch(results)
+        metrics.count_trials(len(results))
+        n_run += len(results)
+        best = algorithm.best()
+        metrics.log(
+            "batch",
+            algo=algorithm.name,
+            backend=backend.name,
+            size=len(batch),
+            best_score=None if best is None else round(best.score, 6),
+        )
+        batches += 1
+        if max_batches is not None and batches >= max_batches:
+            break
+    wall = time.perf_counter() - t0
+    return SearchResult(
+        best=algorithm.best(),
+        n_trials=algorithm.n_trials,
+        wall_s=wall,
+        trials_per_sec_per_chip=n_run / max(wall, 1e-9) / metrics.n_chips,
+    )

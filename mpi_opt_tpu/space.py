@@ -125,7 +125,17 @@ class Choice(Domain):
         return jnp.clip(jnp.floor(u * n), 0, n - 1)
 
     def to_unit(self, v):
+        # v is the DEVICE representation: the option index, not the
+        # option value (use SearchSpace.params_to_unit for typed values —
+        # e.g. for Choice([True, False]) the value True is index 0, but
+        # numerically True == 1 and would silently encode index 1 here)
         return (v + 0.5) / len(self.options)
+
+    def value_to_index(self, value) -> int:
+        for i, opt in enumerate(self.options):
+            if opt is value or (type(opt) is type(value) and opt == value):
+                return i
+        raise ValueError(f"{value!r} is not one of {self.options}")
 
     def materialize(self, v):
         return self.options[int(v)]
@@ -168,12 +178,27 @@ class SearchSpace:
         }
 
     def to_unit(self, values: Mapping[str, jax.Array]) -> jax.Array:
-        """Dict of value arrays -> unit-cube array ``[..., d]``."""
+        """Dict of *device-representation* arrays -> unit cube ``[..., d]``.
+
+        Jittable inverse of ``from_unit``. For Choice domains the device
+        representation is the option index; to encode typed Python
+        values (option objects, bools) use ``params_to_unit``.
+        """
         cols = [
             self.domains[name].to_unit(jnp.asarray(values[name], jnp.float32))
             for name in self.names
         ]
         return jnp.stack(cols, axis=-1)
+
+    def params_to_unit(self, params: Mapping[str, Any]) -> np.ndarray:
+        """Typed-value params dict (one point) -> unit-cube row (host side)."""
+        row = np.zeros(self.dim, dtype=np.float32)
+        for i, (name, dom) in enumerate(self.domains.items()):
+            v = params[name]
+            if isinstance(dom, Choice):
+                v = dom.value_to_index(v)
+            row[i] = float(np.asarray(dom.to_unit(jnp.asarray(float(v)))))
+        return row
 
     def sample(self, key: jax.Array, n: int) -> dict[str, jax.Array]:
         """Sample n points, returned as typed value arrays."""

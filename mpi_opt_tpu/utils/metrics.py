@@ -1,0 +1,63 @@
+"""Results/reporting (SURVEY.md §2 row 12): JSONL metrics + throughput.
+
+Emits one JSON object per event to a stream and/or file, and accounts
+the metric of record (BASELINE.json): trials/sec/chip and wall-clock.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import IO, Optional
+
+
+class MetricsLogger:
+    def __init__(self, path: Optional[str] = None, stream: Optional[IO] = None, n_chips: int = 1):
+        self._file = open(path, "a") if path else None
+        self._stream = stream
+        self.n_chips = max(1, n_chips)
+        self.t_start = time.perf_counter()
+        self.trials_done = 0
+
+    def log(self, event: str, **fields) -> dict:
+        rec = {"event": event, "t": round(time.perf_counter() - self.t_start, 4), **fields}
+        line = json.dumps(rec)
+        if self._file:
+            self._file.write(line + "\n")
+            self._file.flush()
+        if self._stream:
+            print(line, file=self._stream, flush=True)
+        return rec
+
+    def count_trials(self, n: int):
+        self.trials_done += n
+
+    @property
+    def wall(self) -> float:
+        return time.perf_counter() - self.t_start
+
+    def trials_per_sec_per_chip(self) -> float:
+        return self.trials_done / max(self.wall, 1e-9) / self.n_chips
+
+    def summary(self, **extra) -> dict:
+        return self.log(
+            "summary",
+            trials=self.trials_done,
+            wall_s=round(self.wall, 3),
+            trials_per_sec_per_chip=round(self.trials_per_sec_per_chip(), 4),
+            **extra,
+        )
+
+    def close(self):
+        if self._file:
+            self._file.close()
+            self._file = None
+
+
+def null_logger() -> MetricsLogger:
+    return MetricsLogger()
+
+
+def stdout_logger(path: Optional[str] = None, n_chips: int = 1) -> MetricsLogger:
+    return MetricsLogger(path=path, stream=sys.stdout, n_chips=n_chips)

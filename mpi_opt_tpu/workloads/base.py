@@ -1,0 +1,43 @@
+"""Workload protocol."""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional
+
+from mpi_opt_tpu.space import SearchSpace
+
+
+class Workload(abc.ABC):
+    """A tunable training task.
+
+    Subclasses must implement ``default_space`` and at least one of the
+    two evaluation protocols. ``evaluate`` has a default implementation
+    in terms of the stateful protocol.
+    """
+
+    name: str = "base"
+
+    @abc.abstractmethod
+    def default_space(self) -> SearchSpace:
+        ...
+
+    # -- stateful protocol (optional) ------------------------------------
+
+    def init_state(self, params: dict, seed: int) -> Any:
+        raise NotImplementedError(f"{self.name} has no stateful protocol")
+
+    def train(self, state: Any, params: dict, steps: int, seed: int):
+        """Advance training by ``steps``; returns (state, score)."""
+        raise NotImplementedError(f"{self.name} has no stateful protocol")
+
+    @property
+    def stateful(self) -> bool:
+        return type(self).train is not Workload.train
+
+    # -- stateless protocol ----------------------------------------------
+
+    def evaluate(self, params: dict, budget: int, seed: int) -> float:
+        state = self.init_state(params, seed)
+        _, score = self.train(state, params, budget, seed)
+        return float(score)

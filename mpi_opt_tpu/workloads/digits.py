@@ -1,0 +1,66 @@
+"""Config-1 workload: sklearn LogisticRegression on the digits dataset.
+
+BASELINE.json configs[0]: "Random search, 16 trials, sklearn
+LogisticRegression on digits (single-process CPU ref)". This workload
+stays on the CPU path by design — it exists for parity with the
+reference's sklearn-estimator adapter (SURVEY.md §2 row 10), and as the
+minimum end-to-end slice.
+
+Budget semantics: ``budget`` = ``max_iter`` for the lbfgs solver.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+from mpi_opt_tpu.space import Choice, LogUniform, SearchSpace
+from mpi_opt_tpu.workloads import register
+from mpi_opt_tpu.workloads.base import Workload
+
+_CACHE = {}
+
+
+def _data(seed: int):
+    """Fixed train/val split; cached across trials in a worker process."""
+    if seed not in _CACHE:
+        from sklearn.datasets import load_digits
+        from sklearn.model_selection import train_test_split
+
+        d = load_digits()
+        x = d.data.astype(np.float32) / 16.0
+        _CACHE[seed] = train_test_split(
+            x, d.target, test_size=0.25, random_state=seed, stratify=d.target
+        )
+    return _CACHE[seed]
+
+
+@register
+class DigitsLogReg(Workload):
+    name = "digits"
+
+    def default_space(self) -> SearchSpace:
+        return SearchSpace(
+            {
+                "C": LogUniform(1e-4, 1e2),
+                "tol": LogUniform(1e-6, 1e-2),
+                "fit_intercept": Choice([True, False]),
+            }
+        )
+
+    def evaluate(self, params: dict, budget: int, seed: int) -> float:
+        from sklearn.linear_model import LogisticRegression
+
+        xtr, xva, ytr, yva = _data(seed)
+        clf = LogisticRegression(
+            C=float(params["C"]),
+            tol=float(params["tol"]),
+            fit_intercept=bool(params["fit_intercept"]),
+            max_iter=max(1, int(budget)),
+            solver="lbfgs",
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # ConvergenceWarning at low budgets
+            clf.fit(xtr, ytr)
+        return float(clf.score(xva, yva))

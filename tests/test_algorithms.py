@@ -1,0 +1,184 @@
+"""Host-algorithm loop semantics against the quadratic workload."""
+
+import numpy as np
+import pytest
+
+from mpi_opt_tpu.algorithms import ASHA, PBT, RandomSearch, TPE, get_algorithm
+from mpi_opt_tpu.backends.cpu import CPUBackend
+from mpi_opt_tpu.driver import run_search
+from mpi_opt_tpu.trial import TrialStatus
+from mpi_opt_tpu.workloads import get_workload
+
+
+@pytest.fixture(scope="module")
+def workload():
+    return get_workload("quadratic")
+
+
+@pytest.fixture
+def backend(workload):
+    b = CPUBackend(workload, n_workers=1)
+    yield b
+    b.close()
+
+
+def test_registry_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown algorithm"):
+        get_algorithm("gradient_descent")
+
+
+def test_random_search_completes(workload, backend):
+    algo = RandomSearch(workload.default_space(), seed=0, max_trials=12, budget=50)
+    res = run_search(algo, backend)
+    assert res.n_trials == 12
+    assert all(t.status == TrialStatus.DONE for t in algo.trials.values())
+    assert res.best.score is not None
+
+
+def test_asha_budget_ladder_and_stopping(workload, backend):
+    algo = ASHA(
+        workload.default_space(), seed=1, max_trials=27, min_budget=3, max_budget=27, eta=3
+    )
+    res = run_search(algo, backend)
+    assert algo.finished()
+    statuses = [t.status for t in algo.trials.values()]
+    # every trial terminated one way or the other
+    assert all(s in (TrialStatus.DONE, TrialStatus.STOPPED) for s in statuses)
+    # asynchronous halving must stop a nontrivial share of trials early
+    n_stopped = sum(s == TrialStatus.STOPPED for s in statuses)
+    assert n_stopped >= 27 // 2
+    # trials that reached the top rung trained to max_budget
+    for t in algo.trials.values():
+        if t.status == TrialStatus.DONE:
+            assert t.budget == 27
+        assert t.budget in (3, 9, 27)
+
+
+def test_asha_promotion_rule_exact():
+    """First trial at a rung always promotes; later ones need top-1/eta."""
+    from mpi_opt_tpu.trial import TrialResult
+
+    wl = get_workload("quadratic")
+    algo = ASHA(wl.default_space(), seed=2, max_trials=4, min_budget=1, max_budget=3, eta=2)
+    ts = algo.next_batch(4)
+    # report descending scores one by one
+    algo.report_batch([TrialResult(ts[0].trial_id, score=1.0, step=1)])
+    assert algo.trials[ts[0].trial_id].status == TrialStatus.PAUSED  # top-1 of 1
+    algo.report_batch([TrialResult(ts[1].trial_id, score=2.0, step=1)])
+    assert algo.trials[ts[1].trial_id].status == TrialStatus.PAUSED  # top-1 of 2
+    algo.report_batch([TrialResult(ts[2].trial_id, score=0.5, step=1)])
+    assert algo.trials[ts[2].trial_id].status == TrialStatus.STOPPED  # rank 3 of 3
+    algo.report_batch([TrialResult(ts[3].trial_id, score=3.0, step=1)])
+    assert algo.trials[ts[3].trial_id].status == TrialStatus.PAUSED  # top-2 of 4
+
+
+def test_pbt_improves_and_inherits(workload, backend):
+    algo = PBT(
+        workload.default_space(),
+        seed=3,
+        population=8,
+        generations=6,
+        steps_per_generation=5,
+    )
+    res = run_search(algo, backend)
+    assert algo.finished()
+    assert res.n_trials == 8 * 6
+    # the quadratic optimum is lr=1: winners should cluster near it
+    assert res.best.score > -0.15
+    # generation>0 trials must carry inheritance metadata
+    gen2 = [t for t in algo.trials.values() if t.trial_id >= 8]
+    assert all("__inherit_from__" in t.params for t in gen2)
+    assert any(t.params["__inherit_from__"] is not None for t in gen2)
+
+
+def test_tpe_beats_random_on_quadratic(workload):
+    space = workload.default_space()
+    scores = {}
+    for name, cls in (("random", RandomSearch), ("tpe", TPE)):
+        b = CPUBackend(workload, n_workers=1)
+        algo = cls(space, seed=4, max_trials=48, budget=30)
+        res = run_search(algo, b)
+        scores[name] = res.best.score
+        b.close()
+    assert scores["tpe"] >= scores["random"] - 1e-6
+
+
+def test_checkpoint_roundtrip_random(workload):
+    """Resume must finish the remaining trials, not restart the budget."""
+    space = workload.default_space()
+    b1 = CPUBackend(workload, n_workers=1)
+    algo = RandomSearch(space, seed=5, max_trials=8, budget=10)
+    run_search(algo, b1, max_batches=1)
+    b1.close()
+    done_before = sum(t.score is not None for t in algo.trials.values())
+    assert 0 < done_before < 8
+    state = algo.state_dict()
+
+    algo2 = RandomSearch(space, seed=0, max_trials=8, budget=10)
+    algo2.load_state_dict(state)
+    assert algo2.seed == 5
+    b2 = CPUBackend(workload, n_workers=1)
+    run_search(algo2, b2)
+    b2.close()
+    assert algo2.finished()
+    assert len(algo2.trials) == 8  # exactly the remaining trials were added
+    # no duplicated sample points across the resume boundary
+    units = np.stack([t.unit for t in algo2.trials.values()])
+    assert len(np.unique(units.round(6), axis=0)) == 8
+
+
+def test_checkpoint_midflight_asha(workload):
+    """In-flight trials at checkpoint time are re-dispatched on resume."""
+    from mpi_opt_tpu.algorithms import ASHA
+
+    space = workload.default_space()
+    algo = ASHA(space, seed=6, max_trials=9, min_budget=3, max_budget=27, eta=3)
+    batch = algo.next_batch(4)  # dispatched, never reported
+    assert len(batch) == 4
+    state = algo.state_dict()
+
+    algo2 = ASHA(space, seed=0, max_trials=9, min_budget=3, max_budget=27, eta=3)
+    algo2.load_state_dict(state)
+    b = CPUBackend(workload, n_workers=1)
+    run_search(algo2, b)
+    b.close()
+    assert algo2.finished()
+    # the 4 in-flight trials were re-run, not abandoned as RUNNING
+    for t in batch:
+        assert algo2.trials[t.trial_id].score is not None
+
+
+def test_checkpoint_midgeneration_pbt(workload):
+    """A PBT checkpoint mid-generation resumes that generation's members."""
+    space = workload.default_space()
+    algo = PBT(space, seed=7, population=8, generations=3, steps_per_generation=5)
+    first = algo.next_batch(3)  # partial dispatch of generation 0
+    assert len(first) == 3
+    state = algo.state_dict()
+
+    algo2 = PBT(space, seed=0, population=8, generations=3, steps_per_generation=5)
+    algo2.load_state_dict(state)
+    b = CPUBackend(workload, n_workers=1)
+    run_search(algo2, b)
+    b.close()
+    assert algo2.finished()
+    # all 8 members of every generation were evaluated exactly once
+    assert sum(t.score is not None for t in algo2.trials.values()) == 8 * 3
+
+
+def test_pbt_respects_batch_capacity(workload):
+    """next_batch(n) must not exceed n (generational dispatch is chunked)."""
+    space = workload.default_space()
+    algo = PBT(space, seed=8, population=8, generations=2, steps_per_generation=5)
+    b = CPUBackend(workload, n_workers=1)
+    sizes = []
+    while not algo.finished():
+        batch = algo.next_batch(3)
+        if not batch:
+            break
+        sizes.append(len(batch))
+        algo.report_batch(b.evaluate(batch))
+    b.close()
+    assert algo.finished()
+    assert max(sizes) <= 3
+    assert sum(sizes) == 8 * 2

@@ -1,0 +1,74 @@
+"""CPU backend: pool fan-out, stateful inheritance, param hygiene."""
+
+import numpy as np
+import pytest
+
+from mpi_opt_tpu.backends import available_backends, get_backend
+from mpi_opt_tpu.backends.cpu import CPUBackend, _clean
+from mpi_opt_tpu.trial import Trial
+from mpi_opt_tpu.workloads import get_workload
+
+
+def _trial(tid, params, budget, space):
+    unit = space.params_to_unit({k: v for k, v in params.items() if not k.startswith("__")})
+    return Trial(trial_id=tid, params=params, unit=unit, budget=budget)
+
+
+def test_backend_registry():
+    assert "cpu" in available_backends()
+    with pytest.raises(ValueError, match="unknown backend"):
+        get_backend("gpu", get_workload("quadratic"))
+
+
+def test_clean_strips_internal_keys():
+    assert _clean({"lr": 1.0, "__slot__": 3, "__inherit_from__": None}) == {"lr": 1.0}
+
+
+def test_stateless_pool_evaluation():
+    wl = get_workload("digits")
+    space = wl.default_space()
+    b = CPUBackend(wl, n_workers=2)
+    trials = [
+        _trial(0, {"C": 1.0, "tol": 1e-4, "fit_intercept": True}, 60, space),
+        _trial(1, {"C": 0.01, "tol": 1e-4, "fit_intercept": True}, 60, space),
+    ]
+    try:
+        results = b.evaluate(trials)
+    finally:
+        b.close()
+    assert len(results) == 2
+    assert results[0].trial_id == 0 and results[1].trial_id == 1
+    assert 0.5 < results[0].score <= 1.0
+
+
+def test_stateful_warm_resume_matches_budget():
+    """Training 10 then resuming to 30 == training 30 from scratch."""
+    wl = get_workload("quadratic")
+    space = wl.default_space()
+    b = CPUBackend(wl, n_workers=1)
+    params = {"lr": 0.5, "reg": 0.3}
+    t = _trial(0, dict(params), 10, space)
+    r10 = b.evaluate([t])[0]
+    t.budget = 30
+    r30_resumed = b.evaluate([t])[0]
+    b2 = CPUBackend(wl, n_workers=1)
+    t2 = _trial(1, dict(params), 30, space)
+    r30_scratch = b2.evaluate([t2])[0]
+    assert r30_resumed.score == pytest.approx(r30_scratch.score, rel=1e-9)
+    assert r30_resumed.score > r10.score  # more budget, better score (lr<1)
+
+
+def test_stateful_inheritance_copies_source_state():
+    wl = get_workload("quadratic")
+    space = wl.default_space()
+    b = CPUBackend(wl, n_workers=1)
+    good = {"lr": 1.0, "reg": 0.3, "__inherit_from__": None, "__slot__": 0}
+    t0 = _trial(0, good, 5, space)
+    b.evaluate([t0])
+    # child inherits t0's (converged) weights but trains 0 extra steps
+    child_params = {"lr": 1e-3, "reg": 0.3, "__inherit_from__": 0, "__slot__": 1}
+    t1 = _trial(1, child_params, 5, space)
+    r1 = b.evaluate([t1])[0]
+    # inherited w is already ~0 (lr=1 converges in one step), so even with
+    # tiny lr the child's score reflects the inherited optimum
+    assert r1.score > -0.05

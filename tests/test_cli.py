@@ -1,0 +1,54 @@
+"""CLI end-to-end: the config-1 minimum slice, in-process."""
+
+import json
+
+import pytest
+
+from mpi_opt_tpu.cli import build_parser, main
+
+
+def test_parser_defaults():
+    args = build_parser().parse_args(["--workload", "digits"])
+    assert args.backend == "cpu"  # CPU path stays default; tpu is opt-in
+    assert args.algorithm == "random"
+
+
+def test_parser_rejects_unknown_backend():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--workload", "digits", "--backend", "cuda"])
+
+
+def test_config1_minimum_slice(capsys):
+    rc = main(
+        [
+            "--workload", "digits",
+            "--algorithm", "random",
+            "--trials", "4",
+            "--budget", "40",
+            "--workers", "1",
+            "--seed", "0",
+        ]
+    )
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.strip().splitlines() if l.startswith("{")]
+    summary = json.loads(lines[-1])
+    assert summary["n_trials"] == 4
+    assert summary["best_score"] > 0.8
+    assert summary["trials_per_sec_per_chip"] > 0
+    assert "C" in summary["best_params"]
+
+
+def test_cli_quadratic_pbt(capsys):
+    rc = main(
+        [
+            "--workload", "quadratic",
+            "--algorithm", "pbt",
+            "--population", "8",
+            "--generations", "3",
+            "--steps-per-generation", "5",
+            "--workers", "1",
+        ]
+    )
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["n_trials"] == 24

@@ -1,0 +1,47 @@
+"""Regression tests for space edge cases found in review."""
+
+import numpy as np
+import pytest
+
+from mpi_opt_tpu import Choice, IntUniform, LogUniform, SearchSpace, Uniform
+
+
+def test_params_to_unit_bool_choice_not_inverted():
+    # Choice([True, False]): value True is index 0; numeric coercion
+    # (True == 1) would silently encode index 1 == False
+    space = SearchSpace({"fit_intercept": Choice([True, False])})
+    row = space.params_to_unit({"fit_intercept": True})
+    assert space.materialize_row(row)["fit_intercept"] is True
+    row_f = space.params_to_unit({"fit_intercept": False})
+    assert space.materialize_row(row_f)["fit_intercept"] is False
+
+
+def test_params_to_unit_roundtrip_mixed():
+    space = SearchSpace(
+        {
+            "lr": LogUniform(1e-4, 1e-1),
+            "n": IntUniform(2, 9),
+            "act": Choice(["relu", "tanh"]),
+        }
+    )
+    params = {"lr": 3e-3, "n": 7, "act": "tanh"}
+    row = space.params_to_unit(params)
+    back = space.materialize_row(row)
+    assert back["n"] == 7 and back["act"] == "tanh"
+    assert back["lr"] == pytest.approx(3e-3, rel=1e-3)  # unit row is float32
+
+
+def test_params_to_unit_rejects_unknown_choice():
+    space = SearchSpace({"act": Choice(["relu", "tanh"])})
+    with pytest.raises(ValueError, match="not one of"):
+        space.params_to_unit({"act": "gelu"})
+
+
+def test_degenerate_bounds_rejected():
+    with pytest.raises(ValueError):
+        Uniform(0.5, 0.5)
+    with pytest.raises(ValueError):
+        LogUniform(1e-3, 1e-3)
+    with pytest.raises(ValueError):
+        IntUniform(5, 4)
+    IntUniform(5, 5)  # single-point int domain is legal
