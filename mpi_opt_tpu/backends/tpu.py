@@ -1,0 +1,237 @@
+"""TPU population backend: trials are rows of one vmapped population.
+
+This replaces the reference's Coordinator/MPIWorker runtime (SURVEY.md
+§2 rows 7-9; reference unreadable — contract from BASELINE.json
+north_star: "the per-rank trial-evaluation loop becomes a single vmapped
+population kernel running on-device ... registered under the existing
+``backend=`` plugin hook ... opt-in via ``--backend=tpu``").
+
+Architecture:
+
+- A device-resident **slot pool**: ``PopState`` with ``pool_size``
+  member slots (params + momentum), initialized once. Trials map to
+  slots; the mapping lives on the host (tiny), the states never leave
+  the device.
+- ``evaluate(trials)`` groups the batch by remaining training steps
+  (ASHA mixes rungs in one batch), pads each group to a power of two
+  (bounded recompile surface), then per group: gather source states →
+  overwrite fresh members with new inits → ``train_segment`` (the
+  jitted scan-of-vmapped-steps) → eval → scatter back into the pool.
+- PBT inheritance (``__inherit_from__``) and ASHA warm resume are both
+  just gathers from the pool — the reference's MPI weight transfers and
+  re-dispatches collapse into device-side index ops.
+- Eviction: slots are LRU-recycled. Losing a slot is safe — budgets are
+  cumulative, so an evicted trial retrains from scratch to its budget.
+
+The per-search costs that remain on the host: one dataset upload, one
+tiny score download per batch, and the trial ledger.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_opt_tpu.backends.base import Backend, register_backend
+from mpi_opt_tpu.trial import Trial, TrialResult
+from mpi_opt_tpu.workloads.base import Workload
+
+
+@register_backend
+class TPUPopulationBackend(Backend):
+    name = "tpu"
+
+    def __init__(
+        self,
+        workload: Workload,
+        population: int = 32,
+        seed: int = 0,
+        member_chunk: int = 0,
+        slot_slack: int = 2,
+        eval_chunk: int = 1024,
+    ):
+        if not hasattr(workload, "make_trainer"):
+            raise ValueError(
+                f"workload {workload.name!r} has no population protocol "
+                "(make_trainer/make_hparams/data); use --backend cpu"
+            )
+        super().__init__(workload)
+        self.population = population
+        self.seed = seed
+        self.member_chunk = member_chunk
+        self.eval_chunk = eval_chunk
+        # slack >= 2 guarantees every batch can pin its sources (<= pop)
+        # AND allocate its outputs (<= pop) without evicting a pinned
+        # slot; +1 scratch slot absorbs padding writes
+        self.pool_size = population * max(2, slot_slack) + 1
+        self._scratch = self.pool_size - 1
+        self._setup_done = False
+        self._step_counter = 0
+        # host-side ledger
+        self._slot_of: "OrderedDict[int, int]" = OrderedDict()  # trial_id -> slot (LRU order)
+        self._trained: dict[int, int] = {}  # trial_id -> steps completed
+
+    @property
+    def capacity(self) -> int:
+        return self.population
+
+    # -- lazy device setup ------------------------------------------------
+
+    def _setup(self):
+        if self._setup_done:
+            return
+        d = self.workload.data()
+        self._trainer = self.workload.make_trainer(member_chunk=self.member_chunk)
+        self._space = self.workload.default_space()
+        self._train_x = jnp.asarray(d["train_x"])
+        self._train_y = jnp.asarray(d["train_y"])
+        self._val_x = jnp.asarray(d["val_x"])
+        self._val_y = jnp.asarray(d["val_y"])
+        key = jax.random.fold_in(jax.random.key(self.seed), 7001)
+        self._pool = self._trainer.init_population(
+            key, self._train_x[:2], self.pool_size
+        )
+        self._free = [s for s in range(self.pool_size) if s != self._scratch]
+        self._setup_done = True
+
+    # -- slot management --------------------------------------------------
+
+    def _alloc_slot(self, trial_id: int, pinned: set[int]) -> int:
+        if self._free:
+            slot = self._free.pop()
+        else:
+            # evict the least-recently-used *unpinned* trial; retraining
+            # from scratch is always correct because budgets are
+            # cumulative. Slots referenced by the in-flight batch are
+            # pinned — evicting one mid-plan would silently turn a warm
+            # resume into an under-trained fresh init.
+            for old_id, cand in self._slot_of.items():  # LRU order
+                if cand not in pinned:
+                    slot = cand
+                    del self._slot_of[old_id]
+                    self._trained.pop(old_id, None)
+                    break
+            else:
+                raise RuntimeError(
+                    "slot pool exhausted by a single batch; raise slot_slack"
+                )
+        self._slot_of[trial_id] = slot
+        return slot
+
+    def _touch(self, trial_id: int):
+        self._slot_of.move_to_end(trial_id)
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate(self, trials: Sequence[Trial]) -> list[TrialResult]:
+        self._setup()
+        # -- atomic plan over the whole batch -----------------------------
+        # Phase A: resolve every trial's state source against the CURRENT
+        # ledger and pin those slots, so phase-B allocations can never
+        # evict a source this batch still needs.
+        pinned: set[int] = set()
+        resolved = []
+        for t in trials:
+            src = t.params.get("__inherit_from__")
+            if t.trial_id in self._slot_of:  # warm resume
+                src_slot = self._slot_of[t.trial_id]
+                done = self._trained.get(t.trial_id, 0)
+                fresh = False
+                self._touch(t.trial_id)
+            elif src is not None and src in self._slot_of:  # PBT exploit copy
+                src_slot = self._slot_of[src]
+                done = self._trained.get(src, 0)
+                fresh = False
+            else:  # fresh member (or evicted lineage: full retrain)
+                src_slot = self._scratch
+                done = 0
+                fresh = True
+            pinned.add(src_slot)
+            resolved.append((t, src_slot, fresh, done))
+        # Phase B: allocate output slots (own slot for resumes) and group
+        # by remaining steps; each group is one device program.
+        plan: dict[int, list] = {}
+        for t, src_slot, fresh, done in resolved:
+            if t.trial_id in self._slot_of:
+                out_slot = self._slot_of[t.trial_id]
+            else:
+                out_slot = self._alloc_slot(t.trial_id, pinned)
+            pinned.add(out_slot)
+            rem = max(0, t.budget - done)
+            plan.setdefault(rem, []).append((t, src_slot, fresh, out_slot))
+        results: dict[int, TrialResult] = {}
+        for rem, group in sorted(plan.items()):
+            for r in self._run_group(group, rem):
+                results[r.trial_id] = r
+        return [results[t.trial_id] for t in trials]
+
+    def _run_group(self, group: list, steps: int) -> list[TrialResult]:
+        """group: list of (trial, src_slot, fresh, out_slot) plan entries."""
+        t0 = time.perf_counter()
+        n = len(group)
+        n_pad = 1 << (n - 1).bit_length()  # pow2-pad: bounded recompiles
+
+        gather_idx = np.full(n_pad, self._scratch, dtype=np.int32)
+        out_slots = np.full(n_pad, self._scratch, dtype=np.int32)
+        fresh = np.zeros(n_pad, dtype=bool)
+        unit = np.zeros((n_pad, self._space.dim), dtype=np.float32)
+
+        for i, (t, src_slot, is_fresh, out_slot) in enumerate(group):
+            unit[i] = t.unit
+            gather_idx[i] = src_slot
+            fresh[i] = is_fresh
+            out_slots[i] = out_slot
+
+        key = jax.random.fold_in(
+            jax.random.key(self.seed), 9000 + self._step_counter
+        )
+        self._step_counter += 1
+        k_init, k_train = jax.random.split(key)
+
+        # device program: gather -> fresh-overwrite -> train -> eval -> scatter
+        sub = self._trainer.gather_members(self._pool, jnp.asarray(gather_idx))
+        if fresh[:n].any():  # steady-state resume/inherit batches skip init
+            fresh_states = self._trainer.init_population(k_init, self._train_x[:2], n_pad)
+            sub = self._trainer.select_members(jnp.asarray(fresh), fresh_states, sub)
+        hp = self.workload.make_hparams(self._space.from_unit(jnp.asarray(unit)))
+        if steps > 0:
+            sub, _ = self._trainer.train_segment(
+                sub, hp, self._train_x, self._train_y, k_train, steps
+            )
+        scores = self._trainer.eval_population(
+            sub, self._val_x, self._val_y, eval_chunk=self.eval_chunk
+        )
+        self._pool = _scatter(self._pool, sub, jnp.asarray(out_slots))
+
+        scores = np.asarray(scores)
+        wall = time.perf_counter() - t0
+        out = []
+        for i, (t, _, _, _) in enumerate(group):
+            self._trained[t.trial_id] = t.budget
+            out.append(
+                TrialResult(
+                    trial_id=t.trial_id,
+                    score=float(scores[i]),
+                    step=t.budget,
+                    wall_time=wall / n,
+                )
+            )
+        return out
+
+    def close(self):
+        pass
+
+
+@jax.jit
+def _scatter(pool, sub, slots):
+    """Write member states back into their pool slots.
+
+    Padding entries all target the scratch slot; duplicate-index writes
+    there are benign (scratch content is never read as a real member).
+    """
+    return jax.tree.map(lambda p, s: p.at[slots].set(s), pool, sub)

@@ -1,0 +1,13 @@
+"""Model zoo (SURVEY.md §2 row 10): flax modules for the NN workloads.
+
+All models follow TPU conventions: bfloat16 activations with float32
+params and float32 logits/loss, channel-last layouts, GroupNorm instead
+of BatchNorm (no mutable batch statistics — population members must be
+pure pytrees so exploit/explore is a gather, and XLA fuses GN into the
+surrounding ops).
+"""
+
+from mpi_opt_tpu.models.mlp import MLP
+from mpi_opt_tpu.models.cnn import SmallCNN
+
+__all__ = ["MLP", "SmallCNN"]

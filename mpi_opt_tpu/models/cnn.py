@@ -1,0 +1,34 @@
+"""Small CNN — the BASELINE config-3 model (CIFAR-10 scale PBT target)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SmallCNN(nn.Module):
+    """conv32-conv32-pool-conv64-conv64-pool-dense128-dense.
+
+    GroupNorm keeps members stateless (see models package docstring);
+    widths are MXU-friendly multiples.
+    """
+
+    n_classes: int = 10
+    width: int = 32
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.width
+        x = x.astype(self.dtype)
+        for i, ch in enumerate((w, w, 2 * w, 2 * w)):
+            x = nn.Conv(ch, (3, 3), padding="SAME", dtype=self.dtype, name=f"conv{i}")(x)
+            x = nn.GroupNorm(num_groups=8, dtype=self.dtype, name=f"gn{i}")(x)
+            x = nn.relu(x)
+            if i % 2 == 1:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(4 * w, dtype=self.dtype, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.n_classes, dtype=self.dtype, name="fc2")(x)
+        return x.astype(jnp.float32)

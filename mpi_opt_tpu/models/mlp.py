@@ -1,0 +1,20 @@
+"""Two-layer MLP — the BASELINE config-2 model (Fashion-MNIST scale)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    hidden: int = 128
+    n_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        x = nn.Dense(self.hidden, dtype=self.dtype, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.n_classes, dtype=self.dtype, name="fc2")(x)
+        return x.astype(jnp.float32)

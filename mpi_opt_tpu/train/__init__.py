@@ -1,0 +1,5 @@
+"""Population training: the vmapped train-step machinery."""
+
+from mpi_opt_tpu.train.population import OptHParams, PopulationTrainer, PopState
+
+__all__ = ["OptHParams", "PopulationTrainer", "PopState"]

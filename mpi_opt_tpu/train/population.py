@@ -1,0 +1,233 @@
+"""The vmapped population trainer — the framework's hot loop.
+
+Reference call stack being replaced (SURVEY.md §3; reference unreadable,
+contract from BASELINE.json): Coordinator → MPI send → N MPIWorker ranks
+each train one trial → MPI gather of scores. Here the N workers ARE one
+XLA program: ``jax.jit(jax.vmap(member_step))`` over a leading population
+axis, scanned over steps so the whole multi-step training segment is a
+single device computation — hyperparameters are *data* (one row per
+member), so one compilation serves every trial the search will ever
+propose.
+
+Design notes (TPU):
+- member step = loss + grad + SGD/momentum update fused in one vmapped
+  function; XLA sees [P, ...] batched matmuls/convs that tile the MXU.
+- the minibatch is shared across members (one gather from the on-device
+  dataset per step); per-member *augmentation* decorrelates members,
+  with member-folded RNG. Augmentation = per-sample horizontal flip +
+  per-member-per-step circular shift (jnp.roll) — branchless, fusable.
+- hyperparameters (lr, momentum, weight decay, aug strengths) enter as
+  an ``OptHParams`` of [P]-vectors; inside the vmap each member sees
+  scalars. PBT can therefore mutate them between segments without
+  recompiling anything.
+- optimizer state (momentum) lives beside params in ``PopState``; PBT
+  exploit is a single ``jax.tree.map(lambda x: x[src_idx], state)`` —
+  the weight copy the reference does with MPI point-to-point transfers
+  becomes one on-device gather.
+- datasets stay device-resident across the entire search (one host →
+  device transfer per search, vs per-trial pickling over MPI).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class OptHParams:
+    """Per-member hyperparameters; every field is a [P] vector."""
+
+    lr: jax.Array
+    momentum: jax.Array
+    weight_decay: jax.Array
+    flip_prob: jax.Array  # per-sample horizontal flip probability
+    shift: jax.Array  # max augmentation shift in pixels (continuous)
+
+    @staticmethod
+    def defaults(n: int, lr: float = 0.1) -> "OptHParams":
+        f = lambda v: jnp.full((n,), v, dtype=jnp.float32)
+        return OptHParams(f(lr), f(0.9), f(1e-4), f(0.5), f(3.0))
+
+
+@flax.struct.dataclass
+class PopState:
+    """Population training state: leading axis = member."""
+
+    params: Any
+    momentum: Any
+    step: jax.Array  # int32[P]
+
+
+def _augment(key: jax.Array, x: jax.Array, flip_prob: jax.Array, shift: jax.Array):
+    """Per-member augmentation of a shared [B, H, W, C] batch.
+
+    Branchless: flip via a per-sample mask, translation via a circular
+    roll with a traced per-member offset (wrap-around stands in for
+    pad-and-crop; equally effective as regularization, far cheaper to
+    compile than dynamic_slice per sample).
+    """
+    k_flip, k_dy, k_dx = jax.random.split(key, 3)
+    b = x.shape[0]
+    do_flip = jax.random.bernoulli(k_flip, flip_prob, (b, 1, 1, 1))
+    x = jnp.where(do_flip, x[:, :, ::-1, :], x)
+    max_s = jnp.maximum(shift, 0.0)
+    dy = jnp.round(jax.random.uniform(k_dy, (), minval=-max_s, maxval=max_s)).astype(jnp.int32)
+    dx = jnp.round(jax.random.uniform(k_dx, (), minval=-max_s, maxval=max_s)).astype(jnp.int32)
+    return jnp.roll(x, (dy, dx), axis=(1, 2))
+
+
+class PopulationTrainer:
+    """Builds the jitted population train/eval programs for one model.
+
+    Args:
+        apply_fn: ``apply(params, x) -> logits`` (flax ``Module.apply``
+            partial'd over everything but params and inputs).
+        init_fn: ``init(rng, sample_x) -> params``.
+        batch_size: per-step minibatch size (shared across members).
+        augment: whether image augmentation applies (False for tabular).
+        member_chunk: if >0, process members in chunks of this size via
+            ``lax.map`` (activation-memory relief for big populations;
+            params/momentum still resident for all members).
+    """
+
+    def __init__(
+        self,
+        apply_fn: Callable,
+        init_fn: Callable,
+        batch_size: int = 256,
+        augment: bool = True,
+        member_chunk: int = 0,
+    ):
+        self.apply_fn = apply_fn
+        self.init_fn = init_fn
+        self.batch_size = batch_size
+        self.augment = augment
+        self.member_chunk = member_chunk
+
+    # -- init -------------------------------------------------------------
+
+    @functools.partial(jax.jit, static_argnames=("self", "n"))
+    def init_population(self, key: jax.Array, sample_x: jax.Array, n: int) -> PopState:
+        keys = jax.random.split(key, n)
+        params = jax.vmap(lambda k: self.init_fn(k, sample_x))(keys)
+        momentum = jax.tree.map(jnp.zeros_like, params)
+        return PopState(params=params, momentum=momentum, step=jnp.zeros((n,), jnp.int32))
+
+    # -- member-level pieces (scalar hparams; vmapped below) -------------
+
+    def _member_loss(self, params, hp: OptHParams, key, bx, by):
+        if self.augment and bx.ndim == 4:
+            bx = _augment(key, bx, hp.flip_prob, hp.shift)
+        logits = self.apply_fn(params, bx)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(logp, by[:, None], axis=1))
+
+    def _member_update(self, params, momentum, step, hp: OptHParams, key, bx, by):
+        loss, grads = jax.value_and_grad(self._member_loss)(params, hp, key, bx, by)
+        # SGD + momentum + coupled L2 weight decay (wd*p folded into the
+        # gradient, so the effective decay is lr-scaled), hparams as
+        # traced scalars
+        momentum = jax.tree.map(
+            lambda m, g, p: hp.momentum * m + g + hp.weight_decay * p,
+            momentum, grads, params,
+        )
+        params = jax.tree.map(lambda p, m: p - hp.lr * m, params, momentum)
+        return params, momentum, step + 1, loss
+
+    # -- population programs ---------------------------------------------
+
+    def _pop_update(self, state: PopState, hp: OptHParams, keys, bx, by):
+        """One step for the whole population on a shared batch."""
+        fn = lambda p, m, s, hp_m, k: self._member_update(p, m, s, hp_m, k, bx, by)
+        if self.member_chunk > 0:
+            p, m, s, loss = jax.lax.map(
+                lambda args: fn(*args),
+                (state.params, state.momentum, state.step, hp, keys),
+                batch_size=self.member_chunk,
+            )
+        else:
+            p, m, s, loss = jax.vmap(fn)(state.params, state.momentum, state.step, hp, keys)
+        return PopState(params=p, momentum=m, step=s), loss
+
+    @functools.partial(jax.jit, static_argnames=("self", "steps"))
+    def train_segment(
+        self,
+        state: PopState,
+        hp: OptHParams,
+        train_x: jax.Array,
+        train_y: jax.Array,
+        key: jax.Array,
+        steps: int,
+    ) -> tuple[PopState, jax.Array]:
+        """Run ``steps`` shared-batch steps; returns (state, mean losses [steps])."""
+        n = state.step.shape[0]
+        n_data = train_x.shape[0]
+
+        def one_step(carry, t):
+            st, k = carry
+            k, k_batch, k_aug = jax.random.split(k, 3)
+            idx = jax.random.randint(k_batch, (self.batch_size,), 0, n_data)
+            bx = jnp.take(train_x, idx, axis=0)
+            by = jnp.take(train_y, idx, axis=0)
+            member_keys = jax.random.split(k_aug, n)
+            st, loss = self._pop_update(st, hp, member_keys, bx, by)
+            return (st, k), jnp.mean(loss)
+
+        (state, _), losses = jax.lax.scan(one_step, (state, key), jnp.arange(steps))
+        return state, losses
+
+    @functools.partial(jax.jit, static_argnames=("self", "eval_chunk"))
+    def eval_population(
+        self, state: PopState, val_x: jax.Array, val_y: jax.Array, eval_chunk: int = 1024
+    ) -> jax.Array:
+        """Validation accuracy per member: float32[P].
+
+        Scans the val set in fixed chunks so activation memory stays
+        O(P * eval_chunk) regardless of val-set size. The tail chunk is
+        masked, not dropped.
+        """
+        n_val = val_x.shape[0]
+        n_chunks = -(-n_val // eval_chunk)
+        pad = n_chunks * eval_chunk - n_val
+        vx = jnp.pad(val_x, [(0, pad)] + [(0, 0)] * (val_x.ndim - 1))
+        vy = jnp.pad(val_y, (0, pad), constant_values=-1)
+        vx = vx.reshape((n_chunks, eval_chunk) + val_x.shape[1:])
+        vy = vy.reshape((n_chunks, eval_chunk))
+
+        def member_correct(params, cx, cy):
+            logits = self.apply_fn(params, cx)
+            pred = jnp.argmax(logits, axis=-1)
+            return jnp.sum((pred == cy) & (cy >= 0))
+
+        def chunk_step(acc, chunk):
+            cx, cy = chunk
+            acc = acc + jax.vmap(member_correct, in_axes=(0, None, None))(state.params, cx, cy)
+            return acc, None
+
+        correct, _ = jax.lax.scan(chunk_step, jnp.zeros((state.step.shape[0],), jnp.int32), (vx, vy))
+        return correct.astype(jnp.float32) / n_val
+
+    # -- population surgery (exploit / slot management) ------------------
+
+    @staticmethod
+    @jax.jit
+    def gather_members(state: PopState, src_idx: jax.Array) -> PopState:
+        """Exploit/copy: member i continues from member src_idx[i].
+
+        The MPI weight transfer of the reference, as one device gather.
+        """
+        return jax.tree.map(lambda x: x[src_idx], state)
+
+    @staticmethod
+    @jax.jit
+    def select_members(fresh_mask: jax.Array, fresh: PopState, existing: PopState) -> PopState:
+        """Per-member choice between a fresh init and existing state."""
+        def pick(a, b):
+            m = fresh_mask.reshape((-1,) + (1,) * (a.ndim - 1))
+            return jnp.where(m, a, b)
+        return jax.tree.map(pick, fresh, existing)

@@ -1,0 +1,45 @@
+import numpy as np
+import pytest
+
+from mpi_opt_tpu.data import DATASETS, load_dataset
+from mpi_opt_tpu.data.synthetic import make_image_classification
+
+
+def test_registry_and_unknown():
+    assert "cifar10" in DATASETS and "digits" in DATASETS
+    with pytest.raises(ValueError, match="unknown dataset"):
+        load_dataset("imagenet")
+
+
+def test_synthetic_shapes_and_determinism():
+    a = make_image_classification(256, 64, 32, 32, 3, 10, seed=7)
+    b = make_image_classification(256, 64, 32, 32, 3, 10, seed=7)
+    assert a["train_x"].shape == (256, 32, 32, 3)
+    assert a["val_y"].shape == (64,)
+    assert a["train_x"].dtype == np.float32 and a["train_y"].dtype == np.int32
+    np.testing.assert_array_equal(a["train_x"], b["train_x"])  # fully deterministic
+    c = make_image_classification(256, 64, 32, 32, 3, 10, seed=8)
+    assert not np.array_equal(a["train_x"], c["train_x"])  # seed matters
+
+
+def test_synthetic_train_val_disjoint_noise():
+    d = make_image_classification(128, 128, 28, 28, 1, 10, seed=0)
+    assert not np.array_equal(d["train_x"][:64], d["val_x"][:64])
+
+
+def test_sklearn_offline_datasets():
+    d = load_dataset("digits")
+    assert d["train_x"].shape[1] == 64 and d["n_classes"] == 10
+    di = load_dataset("digits_image")
+    assert di["train_x"].shape[1:] == (8, 8, 1)
+    w = load_dataset("wine")
+    assert w["n_classes"] == 3
+    r = load_dataset("diabetes")
+    assert r["n_classes"] == 0  # regression
+    assert r["train_y"].dtype == np.float32
+
+
+def test_cache_returns_same_object():
+    a = load_dataset("cifar10", n_train=128, n_val=32)
+    b = load_dataset("cifar10", n_train=128, n_val=32)
+    assert a is b
