@@ -44,8 +44,19 @@ def _init_pool_worker(workload_name: str, workload_kwargs: dict):
 
     CPU workers must never grab the TPU: the parent may hold it, and N
     spawned children racing to initialize the TPU platform would hang.
+    The env var alone is not enough (a site plugin may pin
+    JAX_PLATFORMS), so also force the platform through jax.config.
     """
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import jax
+    except ImportError:
+        jax = None  # workload may not need jax at all
+    if jax is not None:
+        # No blanket swallow: if the pin fails (backend already up in
+        # this child), continuing would let N workers race the real TPU
+        # and hang — fail loudly instead.
+        jax.config.update("jax_platforms", "cpu")
     _init_worker(workload_name, workload_kwargs)
 
 

@@ -1,0 +1,17 @@
+"""Distributed layer: device meshes, shardings, multi-host bring-up."""
+
+from mpi_opt_tpu.parallel.mesh import (
+    make_mesh,
+    pop_sharding,
+    replicate,
+    shard_popstate,
+    initialize_multihost,
+)
+
+__all__ = [
+    "make_mesh",
+    "pop_sharding",
+    "replicate",
+    "shard_popstate",
+    "initialize_multihost",
+]

@@ -1,0 +1,114 @@
+"""Device mesh + sharding layer (SURVEY.md §2 row 9, §5).
+
+This is the TPU-native replacement for the reference's communication
+backend (MPI collectives over ranks; SURVEY.md attests MPI_Allgather for
+PBT/ASHA decisions — reference unreadable, contract from BASELINE.json).
+
+Design:
+
+- Mesh axes ``('pop', 'data')``. Trial/population parallelism shards the
+  leading member axis over ``pop``; data parallelism *within* a member
+  (config 5, ResNet-scale) shards the batch over ``data``.
+- Population training needs **no hand-written collectives at all**: the
+  members are independent, so sharding the inputs over ``pop`` lets
+  XLA's SPMD partitioner run each shard's members locally — the
+  reference's rank-parallel trial evaluation becomes a layout, not a
+  protocol. With the batch sharded over ``data`` and params replicated
+  across it, the partitioner inserts the gradient ``psum`` over ICI on
+  its own — the all-reduce the reference delegates to MPI.
+- PBT exploit/explore and ASHA cuts operate on [P]-scores and gather
+  along the member axis; over a sharded population XLA lowers these to
+  ``all_gather``/``all_to_all`` over ICI (cross-slice traffic rides DCN
+  if the mesh spans hosts). No code change versus single-chip.
+- Multi-host: ``initialize_multihost`` wraps ``jax.distributed``;
+  ``make_mesh`` then spans all processes' devices (the way an mpirun
+  world spans ranks).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_pop: Optional[int] = None,
+    n_data: int = 1,
+    devices: Optional[list] = None,
+) -> Mesh:
+    """Create a ``('pop', 'data')`` mesh over the available devices.
+
+    ``n_pop`` defaults to ``len(devices) // n_data``. Device order keeps
+    the ``data`` axis innermost so its gradient psum rides neighboring
+    ICI links (the highest-traffic collective gets the shortest hops).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_pop is None:
+        if len(devices) % n_data:
+            raise ValueError(f"{len(devices)} devices not divisible by n_data={n_data}")
+        n_pop = len(devices) // n_data
+    need = n_pop * n_data
+    if need > len(devices):
+        raise ValueError(f"mesh {n_pop}x{n_data} needs {need} devices, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(n_pop, n_data)
+    return Mesh(grid, axis_names=("pop", "data"))
+
+
+def pop_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for arrays with a leading population/member axis."""
+    return NamedSharding(mesh, P("pop"))
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_popstate(state: Any, mesh: Mesh) -> Any:
+    """Place a PopState (or any pytree with leading member axes) so the
+    member axis is sharded over ``pop`` and everything else replicated
+    across ``data``."""
+    sh = pop_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), state)
+
+
+def shard_batch(x: Any, mesh: Mesh) -> Any:
+    """Shard a per-step batch over the ``data`` axis (member-shared)."""
+    sh = NamedSharding(mesh, P("data"))
+    return jax.tree.map(lambda a: jax.device_put(a, sh), x)
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Bring up the multi-host runtime (config 5: v4-32-scale sweeps).
+
+    Mirrors the role of ``mpirun`` + ``MPI_Init`` in the reference: after
+    this, ``jax.devices()`` spans every host's chips and the same mesh
+    code scales out. Arguments default to cluster auto-detection (TPU
+    pod metadata); returns the process index.
+
+    MUST be called before any other JAX operation — even
+    ``jax.process_count()`` initializes the XLA backend, after which
+    distributed bring-up is impossible (jax raises). Therefore no
+    pre-checks here: we attempt initialization directly and only
+    swallow the failure when the caller did not explicitly require a
+    multi-process world (single-process runs, this container).
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs = dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    try:
+        jax.distributed.initialize(**kwargs)
+    except (ValueError, RuntimeError):
+        if num_processes not in (None, 1):
+            raise  # an explicit multi-host request must not silently shrink
+    return jax.process_index()

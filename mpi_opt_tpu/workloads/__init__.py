@@ -35,6 +35,6 @@ def available() -> list[str]:
 
 
 # import for registration side effects
-from mpi_opt_tpu.workloads import digits, synthetic, vision  # noqa: E402,F401
+from mpi_opt_tpu.workloads import digits, synthetic, tabular, vision  # noqa: E402,F401
 
 __all__ = ["Workload", "register", "get_workload", "available"]

@@ -41,3 +41,98 @@ class Workload(abc.ABC):
         state = self.init_state(params, seed)
         _, score = self.train(state, params, budget, seed)
         return float(score)
+
+
+class PopulationWorkload(Workload):
+    """Workloads evaluable as rows of a vmapped population (NN models).
+
+    Subclasses set ``dataset``, ``batch_size``, ``augment`` and implement
+    ``_model(n_classes)``; they get the population protocol consumed by
+    the TPU backend (``data``/``make_trainer``/``make_hparams``) plus a
+    stateless ``evaluate`` (n=1 population, runs on whatever platform the
+    process defaults to — CPU in pool workers), which is the per-rank
+    parity path mirroring the reference's MPIWorker unit of work.
+    """
+
+    dataset: str = ""
+    batch_size: int = 256
+    augment: bool = True
+    # synthetic sets are subsettable; sklearn loaders have fixed sizes
+    # (subclasses with fixed-size data set these to None)
+    default_n_train: int | None = 16384
+    default_n_val: int | None = 2048
+
+    def __init__(self, n_train: int | None = None, n_val: int | None = None):
+        self.n_train = n_train if n_train is not None else self.default_n_train
+        self.n_val = n_val if n_val is not None else self.default_n_val
+        self._data = None
+
+    def _model(self, n_classes: int):
+        raise NotImplementedError
+
+    def data(self) -> dict:
+        if self._data is None:
+            from mpi_opt_tpu.data import load_dataset
+
+            kwargs = {}
+            if self.n_train is not None:
+                kwargs = {"n_train": self.n_train, "n_val": self.n_val}
+            self._data = load_dataset(self.dataset, **kwargs)
+        return self._data
+
+    def make_trainer(self, member_chunk: int = 0):
+        from mpi_opt_tpu.train import PopulationTrainer
+
+        model = self._model(self.data()["n_classes"])
+        return PopulationTrainer(
+            apply_fn=lambda params, x: model.apply({"params": params}, x),
+            init_fn=lambda rng, sample_x: model.init(rng, sample_x)["params"],
+            batch_size=self.batch_size,
+            augment=self.augment,
+            member_chunk=member_chunk,
+        )
+
+    def make_hparams(self, values: dict):
+        import jax.numpy as jnp
+
+        from mpi_opt_tpu.train import OptHParams
+
+        zeros = jnp.zeros_like(values["lr"])
+        return OptHParams(
+            lr=values["lr"],
+            momentum=values["momentum"],
+            weight_decay=values["weight_decay"],
+            flip_prob=values.get("flip_prob", zeros),
+            shift=values.get("shift", zeros),
+        )
+
+    def evaluate(self, params: dict, budget: int, seed: int) -> float:
+        """Single-trial from-scratch training; see class docstring.
+
+        The trainer and device arrays are cached on the instance —
+        train_segment is jitted with ``self`` static, so a fresh trainer
+        per call would recompile every trial.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if not hasattr(self, "_eval_cache"):
+            d = self.data()
+            self._eval_cache = (
+                self.make_trainer(),
+                self.default_space(),
+                jnp.asarray(d["train_x"]),
+                jnp.asarray(d["train_y"]),
+                jnp.asarray(d["val_x"]),
+                jnp.asarray(d["val_y"]),
+            )
+        trainer, unit_space, train_x, train_y, val_x, val_y = self._eval_cache
+        row = unit_space.params_to_unit(params)
+        values = unit_space.from_unit(jnp.asarray(row)[None, :])
+        hp = self.make_hparams(values)
+        key = jax.random.key(seed)
+        k_init, k_train = jax.random.split(key)
+        state = trainer.init_population(k_init, train_x[:2], 1)
+        state, _ = trainer.train_segment(state, hp, train_x, train_y, k_train, int(budget))
+        acc = trainer.eval_population(state, val_x, val_y)
+        return float(acc[0])

@@ -1,0 +1,47 @@
+"""Config-4 workload: surrogate-model sweeps on UCI tabular data.
+
+BASELINE.json configs[3]: "Vectorized TPE acquisition, 256-trial
+surrogate-model sweep on UCI tabular". The tunable surrogate is a small
+MLP over tabular features (sklearn's offline UCI-derived sets — wine,
+breast_cancer; see data package docstring for the no-network policy).
+The interesting half of this config is the TPE side: the acquisition
+scores thousands of candidates in one batched computation
+(ops/tpe.py), and trials are cheap, so suggest-throughput dominates.
+"""
+
+from __future__ import annotations
+
+from mpi_opt_tpu.models import MLP
+from mpi_opt_tpu.space import LogUniform, SearchSpace, Uniform
+from mpi_opt_tpu.workloads import register
+from mpi_opt_tpu.workloads.base import PopulationWorkload
+
+
+@register
+class TabularMLP(PopulationWorkload):
+    name = "tabular_mlp"
+    dataset = "breast_cancer"
+    batch_size = 128
+    augment = False
+    default_n_train = None  # sklearn sets have fixed sizes
+    default_n_val = None
+
+    def __init__(self, dataset: str = "breast_cancer"):
+        super().__init__()
+        self.dataset = dataset
+        if dataset not in ("breast_cancer", "wine"):
+            raise ValueError(
+                f"tabular_mlp supports classification sets breast_cancer/wine, got {dataset!r}"
+            )
+
+    def _model(self, n_classes):
+        return MLP(hidden=64, n_classes=n_classes)
+
+    def default_space(self) -> SearchSpace:
+        return SearchSpace(
+            {
+                "lr": LogUniform(1e-4, 1.0),
+                "momentum": Uniform(0.0, 0.99),
+                "weight_decay": LogUniform(1e-7, 1e-1),
+            }
+        )

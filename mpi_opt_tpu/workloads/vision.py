@@ -1,72 +1,21 @@
-"""NN workloads: configs 2 (MLP/Fashion-MNIST) and 3 (CNN/CIFAR-10).
+"""NN vision workloads: configs 2 (MLP/Fashion-MNIST) and 3 (CNN/CIFAR-10).
 
-Each exposes both evaluation protocols:
-- the population protocol (``make_trainer``/``make_hparams``/``data``)
-  consumed by the TPU backend — the fast path;
-- the generic stateless ``evaluate`` (single member, n=1 population) so
-  the same workload runs on the CPU process-pool backend, which is the
-  in-container stand-in for the reference's per-rank MPI evaluation and
-  the baseline bench.py compares against.
-
-The search space covers optimizer + augmentation-schedule hparams; PBT
-mutates all of them (BASELINE config 3: "lr + aug schedule").
+The population protocol + CPU parity path live in
+``workloads.base.PopulationWorkload``; these classes bind model,
+dataset, and search space. The space covers optimizer + augmentation-
+schedule hparams; PBT mutates all of them (BASELINE config 3: "lr + aug
+schedule").
 """
 
 from __future__ import annotations
 
-import numpy as np
-
-from mpi_opt_tpu.data import load_dataset
 from mpi_opt_tpu.models import MLP, SmallCNN
 from mpi_opt_tpu.space import LogUniform, SearchSpace, Uniform
-from mpi_opt_tpu.train import OptHParams, PopulationTrainer
 from mpi_opt_tpu.workloads import register
-from mpi_opt_tpu.workloads.base import Workload
+from mpi_opt_tpu.workloads.base import PopulationWorkload
 
 
-class _VisionWorkload(Workload):
-    dataset: str = ""
-    batch_size: int = 256
-    augment: bool = True
-
-    def __init__(self, n_train: int = 16384, n_val: int = 2048):
-        self.n_train = n_train
-        self.n_val = n_val
-        self._data = None
-
-    # -- population protocol ---------------------------------------------
-
-    def _model(self, n_classes: int):
-        raise NotImplementedError
-
-    def data(self) -> dict:
-        if self._data is None:
-            self._data = load_dataset(self.dataset, n_train=self.n_train, n_val=self.n_val)
-        return self._data
-
-    def make_trainer(self, member_chunk: int = 0) -> PopulationTrainer:
-        model = self._model(self.data()["n_classes"])
-        return PopulationTrainer(
-            apply_fn=lambda params, x: model.apply({"params": params}, x),
-            init_fn=lambda rng, sample_x: model.init(rng, sample_x)["params"],
-            batch_size=self.batch_size,
-            augment=self.augment,
-            member_chunk=member_chunk,
-        )
-
-    def make_hparams(self, values: dict) -> OptHParams:
-        """Typed value arrays (from SearchSpace.from_unit) -> OptHParams."""
-        import jax.numpy as jnp
-
-        zeros = jnp.zeros_like(values["lr"])
-        return OptHParams(
-            lr=values["lr"],
-            momentum=values["momentum"],
-            weight_decay=values["weight_decay"],
-            flip_prob=values.get("flip_prob", zeros),
-            shift=values.get("shift", zeros),
-        )
-
+class _VisionWorkload(PopulationWorkload):
     def default_space(self) -> SearchSpace:
         return SearchSpace(
             {
@@ -77,41 +26,6 @@ class _VisionWorkload(Workload):
                 "shift": Uniform(0.0, 4.0),
             }
         )
-
-    # -- stateless protocol (CPU pool parity path) -----------------------
-
-    def evaluate(self, params: dict, budget: int, seed: int) -> float:
-        """Single-trial from-scratch training (the per-rank unit of work
-        in the reference's MPI design); n=1 population on whatever
-        backend jax defaults to in this process (CPU in pool workers).
-
-        The trainer and device-resident arrays are cached on the
-        instance: train_segment is jitted with ``self`` static, so a
-        fresh trainer per call would recompile every trial.
-        """
-        import jax
-        import jax.numpy as jnp
-
-        if not hasattr(self, "_eval_cache"):
-            d = self.data()
-            self._eval_cache = (
-                self.make_trainer(),
-                self.default_space(),
-                jnp.asarray(d["train_x"]),
-                jnp.asarray(d["train_y"]),
-                jnp.asarray(d["val_x"]),
-                jnp.asarray(d["val_y"]),
-            )
-        trainer, unit_space, train_x, train_y, val_x, val_y = self._eval_cache
-        row = unit_space.params_to_unit(params)
-        values = unit_space.from_unit(jnp.asarray(row)[None, :])
-        hp = self.make_hparams(values)
-        key = jax.random.key(seed)
-        k_init, k_train = jax.random.split(key)
-        state = trainer.init_population(k_init, train_x[:2], 1)
-        state, _ = trainer.train_segment(state, hp, train_x, train_y, k_train, int(budget))
-        acc = trainer.eval_population(state, val_x, val_y)
-        return float(acc[0])
 
 
 @register

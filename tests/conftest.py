@@ -1,21 +1,24 @@
 """Test harness: force CPU with 8 virtual devices.
 
-Per the build environment, multi-chip TPU hardware is not available;
-sharding/mesh code is validated on a virtual 8-device CPU mesh (the same
-mesh code runs unchanged on real chips). Must run before jax imports.
+Multi-chip TPU hardware is not available in this container; sharding and
+mesh code is validated on a virtual 8-device CPU mesh (the same mesh
+code runs unchanged on real chips).
+
+NOTE: ``JAX_PLATFORMS=cpu`` / ``XLA_FLAGS`` env vars are NOT honored
+here — the axon TPU plugin pins ``JAX_PLATFORMS=axon`` at interpreter
+start via sitecustomize, so platform selection must go through
+``jax.config`` after import (verified: env-var route silently ran the
+whole suite on the real TPU chip).
 """
 
-import os
+import jax
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-
-import jax  # noqa: E402
-
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", False)
-# Persistent compilation cache: the suite is compile-bound on CPU.
-jax.config.update("jax_compilation_cache_dir", "/tmp/mpi_opt_tpu_jax_cache")
+# Persistent compilation cache: the suite is compile-bound. Platform-
+# specific dir — mixing artifacts compiled elsewhere (axon remote
+# compile) triggers machine-feature mismatch warnings/SIGILL risk.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cpu")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

@@ -1,0 +1,28 @@
+"""Config 4: TPE sweep over the tabular surrogate workload."""
+
+import pytest
+
+from mpi_opt_tpu.algorithms import TPE, RandomSearch
+from mpi_opt_tpu.backends import get_backend
+from mpi_opt_tpu.driver import run_search
+from mpi_opt_tpu.workloads import get_workload
+
+
+def test_tabular_rejects_regression_set():
+    with pytest.raises(ValueError, match="classification"):
+        get_workload("tabular_mlp", dataset="diabetes")
+
+
+def test_tpe_sweep_on_tabular_tpu_backend():
+    wl = get_workload("tabular_mlp", dataset="breast_cancer")
+    algo = TPE(wl.default_space(), seed=0, max_trials=24, budget=60, n_startup=8)
+    be = get_backend("tpu", wl, population=8, seed=0)
+    res = run_search(algo, be)
+    assert res.n_trials == 24
+    assert res.best.score > 0.85  # breast_cancer separates easily
+
+
+def test_tabular_cpu_parity_path():
+    wl = get_workload("tabular_mlp", dataset="wine")
+    score = wl.evaluate({"lr": 0.05, "momentum": 0.9, "weight_decay": 1e-5}, budget=80, seed=0)
+    assert 0.5 < score <= 1.0
