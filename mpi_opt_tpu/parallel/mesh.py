@@ -74,12 +74,6 @@ def shard_popstate(state: Any, mesh: Mesh) -> Any:
     return jax.tree.map(lambda x: jax.device_put(x, sh), state)
 
 
-def shard_batch(x: Any, mesh: Mesh) -> Any:
-    """Shard a per-step batch over the ``data`` axis (member-shared)."""
-    sh = NamedSharding(mesh, P("data"))
-    return jax.tree.map(lambda a: jax.device_put(a, sh), x)
-
-
 def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -109,6 +103,7 @@ def initialize_multihost(
     try:
         jax.distributed.initialize(**kwargs)
     except (ValueError, RuntimeError):
-        if num_processes not in (None, 1):
-            raise  # an explicit multi-host request must not silently shrink
+        # an explicit multi-host request must not silently shrink
+        if coordinator_address is not None or num_processes not in (None, 1):
+            raise
     return jax.process_index()

@@ -93,26 +93,37 @@ def fused_pbt(
 
     from mpi_opt_tpu.parallel.mesh import replicate, shard_popstate
 
-    d = workload.data()
-    trainer = workload.make_trainer(member_chunk=member_chunk)
-    space = workload.default_space()
+    # Cache the trainer/space/device-arrays on the workload instance:
+    # they are static jit args (identity-hashed), so rebuilding them per
+    # call would make every fused_pbt invocation a guaranteed retrace.
+    cache = getattr(workload, "_fused_cache", None)
+    if cache is None or cache[0] != member_chunk:
+        d = workload.data()
+        workload._fused_cache = (
+            member_chunk,
+            workload.make_trainer(member_chunk=member_chunk),
+            workload.default_space(),
+            jnp.asarray(d["train_x"]),
+            jnp.asarray(d["train_y"]),
+            jnp.asarray(d["val_x"]),
+            jnp.asarray(d["val_y"]),
+        )
+    _, trainer, space, train_x, train_y, val_x, val_y = workload._fused_cache
     key = jax.random.key(seed)
     k_init, k_unit, k_run = jax.random.split(key, 3)
-
-    train_x, train_y = jnp.asarray(d["train_x"]), jnp.asarray(d["train_y"])
-    val_x, val_y = jnp.asarray(d["val_x"]), jnp.asarray(d["val_y"])
     unit = space.sample_unit(k_unit, population)
     state = trainer.init_population(k_init, train_x[:2], population)
     if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec
+        from mpi_opt_tpu.parallel.mesh import pop_sharding
 
         state = shard_popstate(state, mesh)
-        unit = jax.device_put(unit, NamedSharding(mesh, PartitionSpec("pop")))
+        unit = jax.device_put(unit, pop_sharding(mesh))
         rep = replicate(mesh)
         train_x, train_y = jax.device_put(train_x, rep), jax.device_put(train_y, rep)
         val_x, val_y = jax.device_put(val_x, rep), jax.device_put(val_y, rep)
 
-    # hparams_fn must be hashable-static: build it once from the space
+    # hparams_fn must be hashable-static; space comes from the per-
+    # workload cache above so its identity is stable across calls
     hparams_fn = _HParamsFn(space, workload)
 
     state, unit, best, mean, final_scores = run_fused_pbt(
