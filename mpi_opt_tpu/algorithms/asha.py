@@ -49,7 +49,6 @@ class ASHA(Algorithm):
         self._suggested = 0
         self._promotable: list[int] = []  # trial ids awaiting their next rung
         self._outstanding: set[int] = set()
-        self._requeue: list[int] = []  # in-flight trials recovered from a checkpoint
 
     # -- contract ---------------------------------------------------------
 
@@ -57,11 +56,7 @@ class ASHA(Algorithm):
         out = []
         # trials whose results were lost to a checkpoint/restore cycle
         # get re-dispatched before anything else
-        while self._requeue and len(out) < n:
-            tid = self._requeue.pop(0)
-            t = self.trials[tid]
-            t.status = TrialStatus.RUNNING
-            out.append(t)
+        self._drain_requeue(out, n)
         # continuing trials next: they free memory sooner and drive the
         # search deeper (same priority the async rule gives promotions)
         while self._promotable and len(out) < n:
@@ -119,7 +114,6 @@ class ASHA(Algorithm):
         d["asha"] = {
             "suggested": self._suggested,
             "promotable": list(self._promotable),
-            "outstanding": sorted(self._outstanding | set(self._requeue)),
             "rung_scores": [dict(r) for r in self.rung_scores],
         }
         return d
@@ -133,6 +127,7 @@ class ASHA(Algorithm):
             {int(k): v for k, v in r.items()} for r in a["rung_scores"]
         ]
         self._outstanding = set()
-        # results for in-flight trials died with the old process;
-        # re-dispatch them rather than dropping them as RUNNING forever
-        self._requeue = [int(t) for t in a.get("outstanding", [])]
+        # in-flight trials (still RUNNING in the restored ledger) lost
+        # their results with the old process; re-dispatch them rather
+        # than dropping them as RUNNING forever
+        self._requeue_running()

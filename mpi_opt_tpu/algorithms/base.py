@@ -41,6 +41,7 @@ class Algorithm(abc.ABC):
         self.seed = seed
         self.trials: dict[int, Trial] = {}
         self._next_id = 0
+        self._requeue: list[int] = []  # in-flight trials recovered from a checkpoint
 
     # -- core contract ----------------------------------------------------
 
@@ -73,6 +74,25 @@ class Algorithm(abc.ABC):
         self._next_id += 1
         self.trials[t.trial_id] = t
         return t
+
+    def _drain_requeue(self, out: list, n: int) -> None:
+        """Re-dispatch checkpoint-recovered in-flight trials before any
+        new work (their results died with the old process)."""
+        while self._requeue and len(out) < n:
+            t = self.trials[self._requeue.pop(0)]
+            t.status = TrialStatus.RUNNING
+            out.append(t)
+
+    def _requeue_running(self) -> None:
+        """Recover trials left RUNNING by a checkpoint/restore cycle.
+
+        Without this, a state captured between next_batch and
+        report_batch resumes with suggested > done: next_batch returns
+        [] while finished() is False and the driver deadlocks.
+        """
+        self._requeue = [
+            t.trial_id for t in self.trials.values() if t.status == TrialStatus.RUNNING
+        ]
 
     def best(self) -> Optional[Trial]:
         scored = [t for t in self.trials.values() if t.score is not None]

@@ -23,12 +23,13 @@ class RandomSearch(Algorithm):
         self._done = 0
 
     def next_batch(self, n):
-        take = min(n, self.max_trials - self._suggested)
+        out = []
+        self._drain_requeue(out, n)
+        take = min(n - len(out), self.max_trials - self._suggested)
         if take <= 0:
-            return []
+            return out
         key = jax.random.fold_in(jax.random.key(self.seed), self._suggested)
         unit = np.asarray(self.space.sample_unit(key, take))
-        out = []
         for i in range(take):
             t = self._new_trial(unit[i], budget=self.budget)
             t.status = TrialStatus.RUNNING
@@ -55,3 +56,4 @@ class RandomSearch(Algorithm):
         super().load_state_dict(state)
         self._suggested = state["random"]["suggested"]
         self._done = state["random"]["done"]
+        self._requeue_running()

@@ -50,9 +50,13 @@ class TPE(Algorithm):
         self._suggest_fn = jax.jit(tpe_suggest, static_argnames=("n_suggest", "cfg"))
 
     def next_batch(self, n):
-        take = min(n, self.max_trials - self._suggested)
+        out = []
+        self._drain_requeue(out, n)
+        # the surrogate can only ever score n_candidates points, so a
+        # backend capacity above that is clamped (not an IndexError)
+        take = min(n - len(out), self.max_trials - self._suggested, self.config.n_candidates)
         if take <= 0:
-            return []
+            return out
         key = jax.random.fold_in(jax.random.key(self.seed), self._suggested)
         if self._n_obs < self.n_startup:
             unit = np.asarray(self.space.sample_unit(key, take))
@@ -69,7 +73,6 @@ class TPE(Algorithm):
                 cfg=self.config,
             )
             unit = np.asarray(sugg[:take])
-        out = []
         for i in range(take):
             t = self._new_trial(unit[i], budget=self.budget)
             t.status = TrialStatus.RUNNING
@@ -115,3 +118,4 @@ class TPE(Algorithm):
         self._n_obs = t["n_obs"]
         self._suggested = t["suggested"]
         self._done = t["done"]
+        self._requeue_running()

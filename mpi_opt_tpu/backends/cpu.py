@@ -89,6 +89,7 @@ class CPUBackend(Backend):
         self.seed = seed
         self._workload_kwargs = workload_kwargs or {}
         self._pool = None
+        self._warned_stateful_platform = False
         # trial_id -> training state, FIFO-bounded: PBT mints fresh trial
         # ids every generation and would otherwise accumulate every
         # generation's model states until OOM (inheritance only ever
@@ -121,12 +122,61 @@ class CPUBackend(Backend):
         jobs = [
             (t.trial_id, _clean(t.params), t.budget, self.seed) for t in trials
         ]
-        if self.n_workers == 1 or len(jobs) == 1:
-            _init_worker(self.workload.name, self._workload_kwargs)
+        if (self.n_workers == 1 or len(jobs) == 1) and self._inline_ok():
+            self._ensure_inline_worker()
             return [_eval_one(j) for j in jobs]
         return list(self._get_pool().map(_eval_one, jobs))
 
+    def _inline_ok(self) -> bool:
+        """Inline (in-parent) evaluation is only allowed when the parent
+        is a CPU-platform process: a single-trial batch under
+        ``--backend cpu`` must never train on the TPU just because the
+        parent process defaults to it. Otherwise route through the
+        pinned pool. Side-effect free: never initializes a JAX backend
+        just to ask which one is default (that would acquire the very
+        accelerator this guard exists to avoid touching)."""
+        try:
+            import jax
+        except ImportError:
+            return True
+        try:
+            from jax._src import xla_bridge
+
+            if xla_bridge.backends_are_initialized():
+                return jax.default_backend() == "cpu"
+            # uninitialized: the first entry of jax_platforms is the
+            # platform the parent WOULD initialize; only a cpu-first pin
+            # is safe
+            platforms = (jax.config.jax_platforms or "").split(",")
+            return platforms[0] == "cpu"
+        except Exception:
+            # private-API probe (no stability guarantee): if it breaks,
+            # conservatively route through the pinned pool
+            return False
+
+    def _ensure_inline_worker(self):
+        """Install the parent-side workload once and reuse it across
+        evaluate() calls (a fresh instance per call would discard
+        PopulationWorkload's _eval_cache: recompile + dataset
+        regeneration every batch)."""
+        global _WORKER_WORKLOAD
+        _WORKER_WORKLOAD = self.workload
+
     def _evaluate_stateful(self, t: Trial) -> TrialResult:
+        # stateful training is inherently in-parent (the state store
+        # lives here); on a TPU-default parent that means the "cpu"
+        # backend actually trains on the accelerator — surface it rather
+        # than silently violating the placement the user asked for
+        if not self._warned_stateful_platform and not self._inline_ok():
+            self._warned_stateful_platform = True
+            import warnings
+
+            warnings.warn(
+                "cpu backend: stateful workload trains in the parent process, "
+                "whose JAX platform is not cpu — use --backend tpu for "
+                "on-device population training, or pin the parent to cpu",
+                stacklevel=3,
+            )
         t0 = time.perf_counter()
         params = _clean(t.params)
         src = t.params.get("__inherit_from__")

@@ -91,7 +91,18 @@ def main(argv=None) -> int:
     elif args.backend == "tpu":
         backend_kwargs = {"population": args.population, "seed": args.seed}
     backend = get_backend(args.backend, workload, **backend_kwargs)
-    metrics = stdout_logger(path=args.metrics_file)
+    # the metric of record is trials/sec/CHIP; normalizing by 1 on a
+    # multi-chip TPU run would overstate it by the chip count. Local
+    # devices, not global: each host's driver counts only its own
+    # trials, so dividing by the global count would understate per-chip
+    # throughput by the host count. (On 2-core-per-chip generations this
+    # is per-core, the conservative direction.)
+    n_chips = 1
+    if args.backend == "tpu":
+        import jax
+
+        n_chips = jax.local_device_count()
+    metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
     try:
         result = run_search(algorithm, backend, metrics=metrics)
     finally:

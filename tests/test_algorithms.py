@@ -182,3 +182,44 @@ def test_pbt_respects_batch_capacity(workload):
     assert algo.finished()
     assert max(sizes) <= 3
     assert sum(sizes) == 8 * 2
+
+
+@pytest.mark.parametrize("algo_name", ["random", "tpe"])
+def test_checkpoint_midflight_random_tpe(workload, algo_name):
+    """A state captured between next_batch and report_batch must resume:
+    in-flight trials are re-dispatched, not abandoned as RUNNING (which
+    would deadlock run_search with _suggested > _done)."""
+    cls = get_algorithm(algo_name)
+    space = workload.default_space()
+    algo = cls(space, seed=9, max_trials=6, budget=5)
+    batch = algo.next_batch(4)  # dispatched, never reported
+    assert len(batch) == 4
+    state = algo.state_dict()
+
+    algo2 = cls(space, seed=0, max_trials=6, budget=5)
+    algo2.load_state_dict(state)
+    b = CPUBackend(workload, n_workers=1)
+    run_search(algo2, b)
+    b.close()
+    assert algo2.finished()
+    # the 4 in-flight trials were re-run, not lost
+    for t in batch:
+        assert algo2.trials[t.trial_id].score is not None
+    assert sum(t.score is not None for t in algo2.trials.values()) == 6
+
+
+def test_tpe_clamps_oversized_batch(workload):
+    """capacity > n_candidates must clamp, not IndexError."""
+    from mpi_opt_tpu.ops.tpe import TPEConfig
+
+    space = workload.default_space()
+    algo = TPE(space, seed=3, max_trials=40, budget=1,
+               n_startup=2, config=TPEConfig(n_candidates=8))
+    b = CPUBackend(workload, n_workers=1)
+    # warm past startup so the surrogate path is the one exercised
+    for _ in range(2):
+        algo.report_batch(b.evaluate(algo.next_batch(1)))
+    batch = algo.next_batch(32)  # capacity above n_candidates
+    assert 0 < len(batch) <= 8
+    algo.report_batch(b.evaluate(batch))
+    b.close()
