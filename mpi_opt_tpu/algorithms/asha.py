@@ -8,9 +8,9 @@ without waiting for the rung to fill (the reference coordinates this
 with MPI messages between coordinator and ranks).
 
 Here the promotion rule is evaluated on the host over numpy arrays
-(scores at a rung are tiny); the *synchronous* population-wide variant
-used inside the TPU backend's on-device generation loop uses
-``mpi_opt_tpu.ops.asha_cut`` instead. Budgets are cumulative: a promoted
+(scores at a rung are tiny); the *synchronous* population-wide variant —
+``mpi_opt_tpu.train.fused_asha.fused_sha`` — runs the rung cuts
+on-device through ``mpi_opt_tpu.ops.asha_cut``. Budgets are cumulative: a promoted
 trial's ``budget`` is the next rung's total step count, and stateful
 backends resume from the trial's saved state rather than retraining.
 """

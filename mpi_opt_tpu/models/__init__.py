@@ -9,5 +9,6 @@ surrounding ops).
 
 from mpi_opt_tpu.models.mlp import MLP
 from mpi_opt_tpu.models.cnn import SmallCNN
+from mpi_opt_tpu.models.resnet import BasicBlock, ResNet, ResNet18
 
-__all__ = ["MLP", "SmallCNN"]
+__all__ = ["MLP", "SmallCNN", "BasicBlock", "ResNet", "ResNet18"]

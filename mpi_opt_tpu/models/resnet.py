@@ -1,0 +1,106 @@
+"""ResNet-18 — the BASELINE config-5 model (PBT pop=1024, CIFAR-100).
+
+CIFAR-style ResNet (3x3 stem, no max-pool, 4 stages of basic blocks),
+following the models-package conventions: GroupNorm (stateless members;
+exploit/explore stays a pure gather), bf16 compute with f32 params and
+f32 logits, channel-last.
+
+Population memory math (why config 5 is a multi-chip/chunked config):
+full ResNet-18 is ~11.2M params. Per member, params + SGD momentum in
+f32 = ~90 MB; pop=1024 of those is ~92 GB — an order of magnitude over
+one v5e chip's 16 GB HBM, which is why BASELINE.json puts config 5 on a
+v4-32 (32 chips). On a mesh the population axis shards it: 1024/32
+members per chip = ~2.9 GB resident, comfortable. Single-chip runs cap
+the population (~128 members = 11.5 GB resident) and bound *activation*
+memory with ``member_chunk`` (the trainer lax.map's members in chunks)
+plus ``remat=True`` here, which rematerializes block activations in the
+backward pass (activations drop from every conv output to block
+boundaries, ~8x, for ~33% more FLOPs — the right trade on an
+HBM-limited chip).
+
+Measured on this container's v5e-class chip (2026-07-29, batch 128,
+member_chunk=8, remat on, train_segment donating its input state):
+pop=64 trains at ~158 member-steps/s and sweeps end-to-end under fused
+PBT; pop>=96 fails at compile time in the axon remote compiler. Without
+donation even pop=64 OOMs (old + new population state resident at once
+is 2 x 5.75 GB before activations).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs + identity/projection shortcut."""
+
+    channels: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        # 32 groups at full width; small test widths shrink the count
+        groups = min(32, self.channels)
+        gn = lambda name: nn.GroupNorm(num_groups=groups, dtype=self.dtype, name=name)
+        y = nn.Conv(
+            self.channels, (3, 3), strides=(self.stride, self.stride),
+            padding="SAME", use_bias=False, dtype=self.dtype, name="conv1",
+        )(x)
+        y = nn.relu(gn("gn1")(y))
+        y = nn.Conv(
+            self.channels, (3, 3), padding="SAME", use_bias=False,
+            dtype=self.dtype, name="conv2",
+        )(y)
+        y = gn("gn2")(y)
+        if x.shape[-1] != self.channels or self.stride != 1:
+            x = nn.Conv(
+                self.channels, (1, 1), strides=(self.stride, self.stride),
+                use_bias=False, dtype=self.dtype, name="proj",
+            )(x)
+            x = gn("gn_proj")(x)
+        return nn.relu(x + y)
+
+
+class ResNet(nn.Module):
+    """CIFAR-style ResNet; ResNet-18 = stage_sizes (2, 2, 2, 2).
+
+    ``width`` scales all stage channels (64*width at the stem); tests use
+    small widths/stages for CPU speed without changing program structure.
+    """
+
+    n_classes: int = 100
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.width, (3, 3), padding="SAME", use_bias=False,
+            dtype=self.dtype, name="stem",
+        )(x)
+        x = nn.relu(
+            nn.GroupNorm(num_groups=min(32, self.width), dtype=self.dtype, name="gn_stem")(x)
+        )
+        block_cls = nn.remat(BasicBlock) if self.remat else BasicBlock
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            channels = self.width * (2**stage)
+            for b in range(n_blocks):
+                stride = 2 if stage > 0 and b == 0 else 1
+                x = block_cls(
+                    channels=channels, stride=stride, dtype=self.dtype,
+                    name=f"stage{stage}_block{b}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.n_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet18(n_classes: int = 100, width: int = 64, remat: bool = False) -> ResNet:
+    return ResNet(n_classes=n_classes, stage_sizes=(2, 2, 2, 2), width=width, remat=remat)

@@ -3,3 +3,7 @@
 from mpi_opt_tpu.train.population import OptHParams, PopulationTrainer, PopState
 
 __all__ = ["OptHParams", "PopulationTrainer", "PopState"]
+
+# fused sweep drivers (import lazily where cycles matter):
+#   mpi_opt_tpu.train.fused_pbt.fused_pbt — whole PBT sweep in one jit
+#   mpi_opt_tpu.train.fused_asha.fused_sha — per-rung device programs

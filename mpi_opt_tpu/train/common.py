@@ -1,0 +1,29 @@
+"""Shared plumbing for the fused (whole-sweep-on-device) drivers."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def workload_arrays(workload, member_chunk: int = 0):
+    """(trainer, space, train_x, train_y, val_x, val_y) for a population
+    workload, cached on the workload instance.
+
+    The trainer/space are static jit args (identity-hashed), so
+    rebuilding them per call would make every fused invocation a
+    guaranteed retrace; the device arrays ride along so the dataset is
+    uploaded once per search.
+    """
+    cache = getattr(workload, "_fused_cache", None)
+    if cache is None or cache[0] != member_chunk:
+        d = workload.data()
+        workload._fused_cache = (
+            member_chunk,
+            workload.make_trainer(member_chunk=member_chunk),
+            workload.default_space(),
+            jnp.asarray(d["train_x"]),
+            jnp.asarray(d["train_y"]),
+            jnp.asarray(d["val_x"]),
+            jnp.asarray(d["val_y"]),
+        )
+    return workload._fused_cache[1:]

@@ -1,0 +1,142 @@
+"""Fused successive halving: ASHA's rung reductions on-device.
+
+Reference behavior being replaced (SURVEY.md §2 row 4; BASELINE.json
+north_star: "ASHA rung reductions become lax.top_k over a device mesh
+instead of MPI_Allgather"): the reference promotes trials through budget
+rungs asynchronously because its workers are independent MPI ranks and
+waiting for a rung to fill would idle them. On a TPU the whole cohort
+trains in lockstep as one vmapped population, so the *synchronous*
+variant (successive halving) is the natural execution: train every
+member to the rung budget, evaluate, cut to the top 1/eta with
+``ops.asha.asha_cut``, gather the survivors into a smaller population,
+continue. Stragglers don't exist — every member advances in the same
+XLA program — which is exactly why the async relaxation isn't needed.
+
+Per rung there is ONE host sync (the cut indices come back to update the
+tiny trial ledger); population shapes shrink eta-fold per rung, so a
+sweep compiles at most len(rungs) train/eval program pairs, all cached
+across sweeps.
+
+The cut itself (`_cut_and_gather`) is a jitted kernel: ``asha_cut``
+ranks the cohort, the top-k slice of its descending order picks the
+survivors, and the same index vector gathers member states — the MPI
+Allgather + per-rank promotion decisions + state re-dispatch of the
+reference collapse into one on-device top-k + gather.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from mpi_opt_tpu.ops.asha import asha_cut, asha_rungs
+from mpi_opt_tpu.train.common import workload_arrays
+
+
+@functools.partial(jax.jit, static_argnames=("trainer", "eta", "k"))
+def _cut_and_gather(trainer, state, unit, scores, eta: int, k: int):
+    """One rung reduction: rank, keep the top k, gather their states.
+
+    ``k`` is static (rung cohort sizes are known ahead of time), so the
+    survivor population has a fixed shape for the next rung's program.
+    Returns (survivor_state, survivor_unit, keep_idx, promote_mask).
+    """
+    promote, order = asha_cut(scores, eta)
+    keep = order[:k]
+    return trainer.gather_members(state, keep), unit[keep], keep, promote
+
+
+def sha_cohort_sizes(n_trials: int, n_rungs: int, eta: int, round_to: int = 1) -> list[int]:
+    """Population size at each rung: n, ceil(n/eta), ... (>=1).
+
+    ``round_to`` rounds survivor counts up to a multiple (a sharded
+    population must stay divisible by the mesh's 'pop' axis).
+    """
+    sizes = [n_trials]
+    for _ in range(n_rungs - 1):
+        k = -(-sizes[-1] // eta)  # ceil
+        k = min(sizes[-1], -(-k // round_to) * round_to)
+        sizes.append(max(k, 1))
+    return sizes
+
+
+def fused_sha(
+    workload,
+    n_trials: int,
+    min_budget: int = 10,
+    max_budget: int = 270,
+    eta: int = 3,
+    seed: int = 0,
+    member_chunk: int = 0,
+    mesh=None,
+    round_to: int = 1,
+):
+    """Run a whole successive-halving sweep with on-device rung cuts.
+
+    Returns a dict with the best trial's score/params, per-rung sizes
+    and budgets, and a per-trial ledger (stop rung + last score).
+    """
+    from mpi_opt_tpu.parallel.mesh import pop_sharding, replicate, shard_popstate
+
+    trainer, space, train_x, train_y, val_x, val_y = workload_arrays(
+        workload, member_chunk
+    )
+    rungs = asha_rungs(min_budget, max_budget, eta)
+    if mesh is not None and round_to == 1:
+        round_to = mesh.shape["pop"]
+    sizes = sha_cohort_sizes(n_trials, len(rungs), eta, round_to)
+
+    key = jax.random.key(seed)
+    k_init, k_unit, k_run = jax.random.split(key, 3)
+    unit = space.sample_unit(k_unit, n_trials)
+    state = trainer.init_population(k_init, train_x[:2], n_trials)
+    if mesh is not None:
+        state = shard_popstate(state, mesh)
+        unit = jax.device_put(unit, pop_sharding(mesh))
+        rep = replicate(mesh)
+        train_x, train_y = jax.device_put(train_x, rep), jax.device_put(train_y, rep)
+        val_x, val_y = jax.device_put(val_x, rep), jax.device_put(val_y, rep)
+
+    # host ledger: which original trial occupies each population row
+    alive = np.arange(n_trials)
+    stop_rung = np.zeros(n_trials, dtype=np.int32)
+    last_score = np.full(n_trials, np.nan, dtype=np.float32)
+
+    prev_budget = 0
+    scores = None
+    for r, budget in enumerate(rungs):
+        k_run, k_seg = jax.random.split(k_run)
+        hp = workload.make_hparams(space.from_unit(unit))
+        state, _ = trainer.train_segment(
+            state, hp, train_x, train_y, k_seg, budget - prev_budget
+        )
+        scores = trainer.eval_population(state, val_x, val_y)
+        np_scores = np.asarray(scores)
+        stop_rung[alive] = r
+        last_score[alive] = np_scores
+        prev_budget = budget
+        if r == len(rungs) - 1:
+            break
+        state, unit, keep, _ = _cut_and_gather(
+            trainer, state, unit, scores, eta, sizes[r + 1]
+        )
+        if mesh is not None:
+            # re-place: the gather may leave survivors unsharded/skewed
+            state = shard_popstate(state, mesh)
+            unit = jax.device_put(unit, pop_sharding(mesh))
+        alive = alive[np.asarray(keep)]
+
+    np_unit = np.asarray(unit)
+    best_row = int(np.asarray(scores).argmax())
+    return {
+        "best_score": float(np.asarray(scores)[best_row]),
+        "best_params": space.materialize_row(np_unit[best_row]),
+        "best_trial": int(alive[best_row]),
+        "rung_budgets": rungs,
+        "rung_sizes": sizes,
+        "stop_rung": stop_rung,
+        "last_score": last_score,
+        "n_trials": n_trials,
+    }

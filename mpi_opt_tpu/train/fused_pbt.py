@@ -38,6 +38,7 @@ from mpi_opt_tpu.train.population import OptHParams, PopState, PopulationTrainer
 @functools.partial(
     jax.jit,
     static_argnames=("trainer", "hparams_fn", "discrete_mask", "generations", "steps_per_gen", "cfg"),
+    donate_argnames=("state", "unit"),
 )
 def run_fused_pbt(
     trainer: PopulationTrainer,
@@ -92,23 +93,11 @@ def fused_pbt(
     import numpy as np
 
     from mpi_opt_tpu.parallel.mesh import replicate, shard_popstate
+    from mpi_opt_tpu.train.common import workload_arrays
 
-    # Cache the trainer/space/device-arrays on the workload instance:
-    # they are static jit args (identity-hashed), so rebuilding them per
-    # call would make every fused_pbt invocation a guaranteed retrace.
-    cache = getattr(workload, "_fused_cache", None)
-    if cache is None or cache[0] != member_chunk:
-        d = workload.data()
-        workload._fused_cache = (
-            member_chunk,
-            workload.make_trainer(member_chunk=member_chunk),
-            workload.default_space(),
-            jnp.asarray(d["train_x"]),
-            jnp.asarray(d["train_y"]),
-            jnp.asarray(d["val_x"]),
-            jnp.asarray(d["val_y"]),
-        )
-    _, trainer, space, train_x, train_y, val_x, val_y = workload._fused_cache
+    trainer, space, train_x, train_y, val_x, val_y = workload_arrays(
+        workload, member_chunk
+    )
     key = jax.random.key(seed)
     k_init, k_unit, k_run = jax.random.split(key, 3)
     unit = space.sample_unit(k_unit, population)

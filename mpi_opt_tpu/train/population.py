@@ -93,6 +93,13 @@ class PopulationTrainer:
         member_chunk: if >0, process members in chunks of this size via
             ``lax.map`` (activation-memory relief for big populations;
             params/momentum still resident for all members).
+        donate: donate the input state to ``train_segment`` so XLA can
+            reuse its buffers for the output instead of holding old and
+            new population state simultaneously — the difference between
+            1x and 2x resident params+momentum, which is what caps the
+            single-chip ResNet population. Callers must not touch a
+            state after passing it in (``make_trainer`` turns this on;
+            keep it off when comparing states across calls).
     """
 
     def __init__(
@@ -102,12 +109,22 @@ class PopulationTrainer:
         batch_size: int = 256,
         augment: bool = True,
         member_chunk: int = 0,
+        donate: bool = False,
     ):
         self.apply_fn = apply_fn
         self.init_fn = init_fn
         self.batch_size = batch_size
         self.augment = augment
         self.member_chunk = member_chunk
+        self.donate = donate
+        self.train_segment = functools.partial(
+            jax.jit(
+                type(self)._train_segment,
+                static_argnames=("self", "steps"),
+                donate_argnames=("state",) if donate else (),
+            ),
+            self,
+        )
 
     # -- init -------------------------------------------------------------
 
@@ -154,8 +171,7 @@ class PopulationTrainer:
             p, m, s, loss = jax.vmap(fn)(state.params, state.momentum, state.step, hp, keys)
         return PopState(params=p, momentum=m, step=s), loss
 
-    @functools.partial(jax.jit, static_argnames=("self", "steps"))
-    def train_segment(
+    def _train_segment(
         self,
         state: PopState,
         hp: OptHParams,
@@ -164,7 +180,11 @@ class PopulationTrainer:
         key: jax.Array,
         steps: int,
     ) -> tuple[PopState, jax.Array]:
-        """Run ``steps`` shared-batch steps; returns (state, mean losses [steps])."""
+        """Run ``steps`` shared-batch steps; returns (state, mean losses [steps]).
+
+        Jitted as ``self.train_segment`` in __init__ (donation is
+        per-instance, so the jit wrapper cannot be a class decorator).
+        """
         n = state.step.shape[0]
         n_data = train_x.shape[0]
 
@@ -188,8 +208,11 @@ class PopulationTrainer:
         """Validation accuracy per member: float32[P].
 
         Scans the val set in fixed chunks so activation memory stays
-        O(P * eval_chunk) regardless of val-set size. The tail chunk is
-        masked, not dropped.
+        O(P * eval_chunk) regardless of val-set size; with
+        ``member_chunk`` set, members are additionally lax.map'ed in
+        chunks, bounding activations at O(member_chunk * eval_chunk) —
+        ResNet-scale populations OOM the forward pass without this. The
+        tail chunk is masked, not dropped.
         """
         n_val = val_x.shape[0]
         n_chunks = -(-n_val // eval_chunk)
@@ -206,7 +229,15 @@ class PopulationTrainer:
 
         def chunk_step(acc, chunk):
             cx, cy = chunk
-            acc = acc + jax.vmap(member_correct, in_axes=(0, None, None))(state.params, cx, cy)
+            if self.member_chunk > 0:
+                corr = jax.lax.map(
+                    lambda p: member_correct(p, cx, cy),
+                    state.params,
+                    batch_size=self.member_chunk,
+                )
+            else:
+                corr = jax.vmap(member_correct, in_axes=(0, None, None))(state.params, cx, cy)
+            acc = acc + corr
             return acc, None
 
         correct, _ = jax.lax.scan(chunk_step, jnp.zeros((state.step.shape[0],), jnp.int32), (vx, vy))

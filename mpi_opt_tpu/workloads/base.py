@@ -80,7 +80,7 @@ class PopulationWorkload(Workload):
             self._data = load_dataset(self.dataset, **kwargs)
         return self._data
 
-    def make_trainer(self, member_chunk: int = 0):
+    def make_trainer(self, member_chunk: int = 0, donate: bool = True):
         from mpi_opt_tpu.train import PopulationTrainer
 
         model = self._model(self.data()["n_classes"])
@@ -90,6 +90,7 @@ class PopulationWorkload(Workload):
             batch_size=self.batch_size,
             augment=self.augment,
             member_chunk=member_chunk,
+            donate=donate,
         )
 
     def make_hparams(self, values: dict):

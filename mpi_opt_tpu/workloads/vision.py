@@ -9,7 +9,7 @@ schedule").
 
 from __future__ import annotations
 
-from mpi_opt_tpu.models import MLP, SmallCNN
+from mpi_opt_tpu.models import MLP, ResNet18, SmallCNN
 from mpi_opt_tpu.space import LogUniform, SearchSpace, Uniform
 from mpi_opt_tpu.workloads import register
 from mpi_opt_tpu.workloads.base import PopulationWorkload
@@ -52,10 +52,34 @@ class Cifar10CNN(_VisionWorkload):
 
 @register
 class Cifar100CNN(_VisionWorkload):
-    """CIFAR-100-shaped variant (config 5 uses ResNet-18; see resnet.py)."""
+    """CIFAR-100-shaped variant of the small CNN (cheap stand-in)."""
 
     name = "cifar100_cnn"
     dataset = "cifar100"
 
     def _model(self, n_classes):
         return SmallCNN(n_classes=n_classes, width=64)
+
+
+@register
+class Cifar100ResNet18(_VisionWorkload):
+    """Config 5: ResNet-18 on (synthetic) CIFAR-100, PBT pop=1024.
+
+    The full population only fits HBM sharded over a mesh's 'pop' axis
+    or capped per chip — see models/resnet.py for the memory math.
+    ``remat`` (on by default) bounds activation memory so the population
+    cap is set by param+momentum residency, not by the backward pass;
+    ``width``/``stage_sizes`` shrink the model for CPU-mesh dry runs.
+    """
+
+    name = "cifar100_resnet18"
+    dataset = "cifar100"
+    batch_size = 128
+
+    def __init__(self, n_train=None, n_val=None, width: int = 64, remat: bool = True):
+        super().__init__(n_train=n_train, n_val=n_val)
+        self.width = width
+        self.remat = remat
+
+    def _model(self, n_classes):
+        return ResNet18(n_classes=n_classes, width=self.width, remat=self.remat)

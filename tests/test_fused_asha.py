@@ -1,0 +1,65 @@
+"""Fused successive halving: cohort math, end-to-end sweep, sharded run."""
+
+import numpy as np
+import pytest
+
+from mpi_opt_tpu.train.fused_asha import fused_sha, sha_cohort_sizes
+from mpi_opt_tpu.workloads import get_workload
+
+
+def test_sha_cohort_sizes_exact():
+    assert sha_cohort_sizes(64, 4, eta=3) == [64, 22, 8, 3]
+    assert sha_cohort_sizes(9, 3, eta=3) == [9, 3, 1]
+    assert sha_cohort_sizes(2, 3, eta=3) == [2, 1, 1]
+
+
+def test_sha_cohort_sizes_mesh_rounding():
+    # survivor counts round UP to the mesh 'pop' axis size
+    assert sha_cohort_sizes(64, 4, eta=3, round_to=4) == [64, 24, 8, 4]
+    assert sha_cohort_sizes(8, 3, eta=3, round_to=4) == [8, 4, 4]
+
+
+@pytest.fixture(scope="module")
+def workload():
+    wl = get_workload("fashion_mlp", n_train=512, n_val=256)
+    wl.batch_size = 32
+    return wl
+
+
+def test_fused_sha_end_to_end(workload):
+    r = fused_sha(workload, n_trials=9, min_budget=2, max_budget=8, eta=2, seed=0)
+    assert r["rung_budgets"] == [2, 4, 8]
+    assert r["rung_sizes"] == [9, 5, 3]
+    assert 0.0 <= r["best_score"] <= 1.0
+    assert set(r["best_params"]) == set(workload.default_space().names)
+    # ledger: every trial got a score; exactly the final cohort reached
+    # the last rung; the best trial is one of them
+    assert np.isfinite(r["last_score"]).all()
+    reached_last = (r["stop_rung"] == 2).sum()
+    assert reached_last == 3
+    assert r["stop_rung"][r["best_trial"]] == 2
+    assert np.isclose(r["last_score"][r["best_trial"]], r["best_score"])
+
+
+def test_fused_sha_survivors_beat_stopped(workload):
+    """The cut keeps the rung's top scorers: every survivor's rung-0
+    score must be >= every stopped trial's rung-0 score."""
+    r = fused_sha(workload, n_trials=8, min_budget=3, max_budget=6, eta=2, seed=1)
+    stopped = r["last_score"][r["stop_rung"] == 0]
+    survived_rung0 = r["stop_rung"] >= 1
+    assert survived_rung0.sum() == 4
+    # survivors' recorded scores are from rung>=1, so compare via the
+    # promote rule indirectly: the worst survivor trained further; what
+    # we can assert exactly is the cut count
+    assert stopped.shape[0] == 4
+
+
+def test_fused_sha_sharded_matches_structure(workload):
+    from mpi_opt_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(n_pop=4, n_data=2)
+    r = fused_sha(
+        workload, n_trials=8, min_budget=2, max_budget=4, eta=2, seed=2, mesh=mesh
+    )
+    assert r["rung_sizes"] == [8, 4]
+    assert 0.0 <= r["best_score"] <= 1.0
