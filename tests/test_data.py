@@ -43,3 +43,14 @@ def test_cache_returns_same_object():
     a = load_dataset("cifar10", n_train=128, n_val=32)
     b = load_dataset("cifar10", n_train=128, n_val=32)
     assert a is b
+
+
+def test_difficulty_kwargs_passthrough():
+    """The synthetic image sets expose their difficulty knobs."""
+    from mpi_opt_tpu.data import load_dataset
+
+    easy = load_dataset("cifar10", n_train=64, n_val=16, delta=0.5)
+    hard = load_dataset("cifar10", n_train=64, n_val=16, delta=0.05)
+    import numpy as np
+
+    assert not np.allclose(easy["train_x"], hard["train_x"])
