@@ -5,21 +5,23 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def workload_arrays(workload, member_chunk: int = 0):
+def workload_arrays(workload, member_chunk: int = 0, mesh=None):
     """(trainer, space, train_x, train_y, val_x, val_y) for a population
     workload, cached on the workload instance.
 
     The trainer/space are static jit args (identity-hashed), so
     rebuilding them per call would make every fused invocation a
     guaranteed retrace; the device arrays ride along so the dataset is
-    uploaded once per search.
+    uploaded once per search. ``mesh`` is part of the cache key: a
+    meshed trainer constrains its batches over the 'data' axis, which
+    changes the compiled program.
     """
     cache = getattr(workload, "_fused_cache", None)
-    if cache is None or cache[0] != member_chunk:
+    if cache is None or cache[0] != (member_chunk, mesh):
         d = workload.data()
         workload._fused_cache = (
-            member_chunk,
-            workload.make_trainer(member_chunk=member_chunk),
+            (member_chunk, mesh),
+            workload.make_trainer(member_chunk=member_chunk, mesh=mesh),
             workload.default_space(),
             jnp.asarray(d["train_x"]),
             jnp.asarray(d["train_y"]),

@@ -81,7 +81,7 @@ def fused_sha(
     from mpi_opt_tpu.parallel.mesh import pop_sharding, replicate, shard_popstate
 
     trainer, space, train_x, train_y, val_x, val_y = workload_arrays(
-        workload, member_chunk
+        workload, member_chunk, mesh
     )
     rungs = asha_rungs(min_budget, max_budget, eta)
     if mesh is not None and round_to == 1:

@@ -96,7 +96,7 @@ def fused_pbt(
     from mpi_opt_tpu.train.common import workload_arrays
 
     trainer, space, train_x, train_y, val_x, val_y = workload_arrays(
-        workload, member_chunk
+        workload, member_chunk, mesh
     )
     key = jax.random.key(seed)
     k_init, k_unit, k_run = jax.random.split(key, 3)

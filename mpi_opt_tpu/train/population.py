@@ -100,6 +100,15 @@ class PopulationTrainer:
             single-chip ResNet population. Callers must not touch a
             state after passing it in (``make_trainer`` turns this on;
             keep it off when comparing states across calls).
+        mesh: optional ``('pop','data')`` Mesh. When set, every train/
+            eval batch carries a sharding constraint over the ``data``
+            axis, so within-member compute is data-parallel: each data
+            shard computes grads on its slice of the shared batch and
+            the SPMD partitioner inserts the gradient all-reduce over
+            ``data`` — the MPI allreduce of a data-parallel rank block,
+            as a layout annotation (tested by HLO inspection in
+            tests/test_parallel.py). Without the constraint the batch
+            is replicated and the axis does nothing.
     """
 
     def __init__(
@@ -110,6 +119,7 @@ class PopulationTrainer:
         augment: bool = True,
         member_chunk: int = 0,
         donate: bool = False,
+        mesh=None,
     ):
         self.apply_fn = apply_fn
         self.init_fn = init_fn
@@ -117,6 +127,12 @@ class PopulationTrainer:
         self.augment = augment
         self.member_chunk = member_chunk
         self.donate = donate
+        self.mesh = mesh
+        if mesh is not None and batch_size % mesh.shape["data"]:
+            raise ValueError(
+                f"batch_size {batch_size} not divisible by the mesh 'data' "
+                f"axis ({mesh.shape['data']})"
+            )
         self.train_segment = functools.partial(
             jax.jit(
                 type(self)._train_segment,
@@ -156,6 +172,17 @@ class PopulationTrainer:
         params = jax.tree.map(lambda p, m: p - hp.lr * m, params, momentum)
         return params, momentum, step + 1, loss
 
+    def _constrain_data(self, bx, by):
+        """Shard a batch over the mesh 'data' axis (no-op without a mesh)."""
+        if self.mesh is None:
+            return bx, by
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sh = lambda a: jax.lax.with_sharding_constraint(
+            a, NamedSharding(self.mesh, PartitionSpec("data"))
+        )
+        return sh(bx), sh(by)
+
     # -- population programs ---------------------------------------------
 
     def _pop_update(self, state: PopState, hp: OptHParams, keys, bx, by):
@@ -194,6 +221,7 @@ class PopulationTrainer:
             idx = jax.random.randint(k_batch, (self.batch_size,), 0, n_data)
             bx = jnp.take(train_x, idx, axis=0)
             by = jnp.take(train_y, idx, axis=0)
+            bx, by = self._constrain_data(bx, by)
             member_keys = jax.random.split(k_aug, n)
             st, loss = self._pop_update(st, hp, member_keys, bx, by)
             return (st, k), jnp.mean(loss)
@@ -229,6 +257,7 @@ class PopulationTrainer:
 
         def chunk_step(acc, chunk):
             cx, cy = chunk
+            cx, cy = self._constrain_data(cx, cy)
             if self.member_chunk > 0:
                 corr = jax.lax.map(
                     lambda p: member_correct(p, cx, cy),

@@ -80,7 +80,7 @@ class PopulationWorkload(Workload):
             self._data = load_dataset(self.dataset, **kwargs)
         return self._data
 
-    def make_trainer(self, member_chunk: int = 0, donate: bool = True):
+    def make_trainer(self, member_chunk: int = 0, donate: bool = True, mesh=None):
         from mpi_opt_tpu.train import PopulationTrainer
 
         model = self._model(self.data()["n_classes"])
@@ -91,6 +91,7 @@ class PopulationWorkload(Workload):
             augment=self.augment,
             member_chunk=member_chunk,
             donate=donate,
+            mesh=mesh,
         )
 
     def make_hparams(self, values: dict):

@@ -39,12 +39,56 @@ def test_fused_pbt_learns(workload):
 
 def test_fused_pbt_sharded_matches_unsharded(workload):
     """The same fused sweep over a ('pop','data') mesh must produce the
-    same result — sharding is a layout, not a semantics change."""
+    same result — sharding is a layout, not a semantics change.
+
+    Tolerance: measured single- vs 4x2-mesh divergence is <0.01 (bf16
+    reduction-order noise over 20 training steps); 0.02 leaves margin
+    without hiding a real semantics change."""
     r1 = fused_pbt(workload, population=8, generations=2, steps_per_gen=10, seed=3)
     mesh = make_mesh(n_pop=4, n_data=2)
     r2 = fused_pbt(workload, population=8, generations=2, steps_per_gen=10, seed=3, mesh=mesh)
-    assert r2["best_score"] == pytest.approx(r1["best_score"], abs=0.08)
-    np.testing.assert_allclose(r2["mean_curve"], r1["mean_curve"], atol=0.08)
+    assert r2["best_score"] == pytest.approx(r1["best_score"], abs=0.02)
+    np.testing.assert_allclose(r2["mean_curve"], r1["mean_curve"], atol=0.02)
+
+
+def _count_tensor_allreduces(workload, n_pop, n_data):
+    """Compile one train segment over an (n_pop, n_data) mesh and count
+    all-reduce ops over non-scalar tensors in the optimized HLO."""
+    import re
+
+    import jax.numpy as jnp
+
+    from mpi_opt_tpu.parallel.mesh import replicate
+
+    d = workload.data()
+    tx, ty = jnp.asarray(d["train_x"]), jnp.asarray(d["train_y"])
+    mesh = make_mesh(n_pop=n_pop, n_data=n_data)
+    trainer = workload.make_trainer(mesh=mesh)
+    st = shard_popstate(
+        trainer.init_population(jax.random.key(0), tx[:2], 8), mesh
+    )
+    space = workload.default_space()
+    hp = workload.make_hparams(space.from_unit(space.sample_unit(jax.random.key(1), 8)))
+    txp, typ = jax.device_put(tx, replicate(mesh)), jax.device_put(ty, replicate(mesh))
+    lowered = trainer.train_segment.func.lower(
+        trainer, st, hp, txp, typ, jax.random.key(2), 3
+    )
+    txt = lowered.compile().as_text()
+    return sum(
+        1
+        for line in txt.splitlines()
+        if "all-reduce(" in line and re.search(r"(f32|bf16|i32|u32)\[\d", line)
+    )
+
+
+def test_data_axis_inserts_gradient_allreduce(workload):
+    """The 'data' axis must be real: sharding the batch over it makes
+    the SPMD partitioner emit a gradient all-reduce (the reference's
+    data-parallel MPI allreduce). Pop-only meshes have only the scalar
+    loss-mean all-reduce; if the batch constraint is dropped, the
+    tensor all-reduce disappears and this test fails."""
+    assert _count_tensor_allreduces(workload, n_pop=8, n_data=1) == 0
+    assert _count_tensor_allreduces(workload, n_pop=2, n_data=4) > 0
 
 
 def test_shard_popstate_places_on_mesh(workload):
