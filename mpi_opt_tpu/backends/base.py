@@ -60,3 +60,24 @@ class Backend(abc.ABC):
 
     def close(self) -> None:
         pass
+
+    # -- checkpoint/resume (utils/checkpoint.py) -------------------------
+    #
+    # Backends without device-resident state use the defaults: losing a
+    # worker's in-progress training is always CORRECT here because
+    # budgets are cumulative — a resumed trial whose state is gone
+    # retrains from scratch to its budget (slower, never wrong).
+
+    def host_state_dict(self) -> dict:
+        """JSON-able host-side state (ledgers, counters)."""
+        return {}
+
+    def load_host_state_dict(self, state: dict) -> None:
+        pass
+
+    def device_state(self):
+        """Device-resident pytree worth persisting (None if stateless)."""
+        return None
+
+    def load_device_state(self, pool) -> None:
+        raise NotImplementedError(f"{self.name} backend has no device state")

@@ -227,6 +227,58 @@ class TPUPopulationBackend(Backend):
     def close(self):
         pass
 
+    # -- checkpoint/resume ------------------------------------------------
+    #
+    # The slot pool is the expensive thing to lose: every live trial's
+    # params + momentum. host_state_dict carries the ledger that gives
+    # the pool meaning (trial -> slot, steps trained, RNG counter);
+    # device_state is the pool pytree itself.
+
+    def host_state_dict(self) -> dict:
+        if not self._setup_done:
+            return {"setup": False}
+        return {
+            "setup": True,
+            "slot_of": list(self._slot_of.items()),  # preserves LRU order
+            "trained": list(self._trained.items()),
+            "free": list(self._free),
+            "step_counter": self._step_counter,
+        }
+
+    def load_host_state_dict(self, state: dict) -> None:
+        if not state.get("setup", False):
+            return
+        self._setup()
+        self._slot_of = OrderedDict((int(k), int(v)) for k, v in state["slot_of"])
+        self._trained = {int(k): int(v) for k, v in state["trained"]}
+        self._free = [int(s) for s in state["free"]]
+        self._step_counter = int(state["step_counter"])
+
+    def device_state(self):
+        return self._pool if self._setup_done else None
+
+    def load_device_state(self, pool) -> None:
+        """Install a restored pool (numpy pytree from orbax) on-device."""
+        from mpi_opt_tpu.train import PopState
+
+        self._setup()
+        if not isinstance(pool, PopState):
+            # orbax round-trips the flax.struct dataclass as a plain dict
+            pool = PopState(
+                params=pool["params"], momentum=pool["momentum"], step=pool["step"]
+            )
+        got = jax.tree.structure(pool)
+        want = jax.tree.structure(self._pool)
+        if got != want:
+            raise ValueError(
+                f"restored pool structure {got} does not match this "
+                f"backend's pool {want} (different workload/population?)"
+            )
+        # free the freshly-initialized pool BEFORE uploading the restored
+        # one: a ResNet-scale pool cannot afford 2x residency
+        self._pool = None
+        self._pool = jax.tree.map(jnp.asarray, pool)
+
 
 @functools.partial(jax.jit, donate_argnames=("pool",))
 def _scatter(pool, sub, slots):

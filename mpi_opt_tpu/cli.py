@@ -41,6 +41,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--budget", type=int, default=100, help="steps per trial (random/tpe)")
     p.add_argument("--workers", type=int, default=0, help="cpu backend: processes (0=auto)")
     p.add_argument("--metrics-file", default=None, help="JSONL metrics output path")
+    # checkpoint/resume (SURVEY.md §2 row 13, §5)
+    p.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        help="durable search checkpoints (orbax) written here after each batch",
+    )
+    p.add_argument(
+        "--checkpoint-every", type=int, default=1, help="batches between checkpoints"
+    )
+    p.add_argument(
+        "--resume",
+        action="store_true",
+        help="resume from the latest checkpoint in --checkpoint-dir "
+        "(starts fresh if the directory is empty)",
+    )
     # ASHA
     p.add_argument("--min-budget", type=int, default=10)
     p.add_argument("--max-budget", type=int, default=270)
@@ -81,7 +96,10 @@ def make_algorithm(args, space):
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.resume and not args.checkpoint_dir:
+        parser.error("--resume requires --checkpoint-dir")
     workload = get_workload(args.workload)
     space = workload.default_space()
     algorithm = make_algorithm(args, space)
@@ -103,10 +121,20 @@ def main(argv=None) -> int:
 
         n_chips = jax.local_device_count()
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
+    checkpointer = None
+    if args.checkpoint_dir:
+        from mpi_opt_tpu.utils.checkpoint import SearchCheckpointer
+
+        checkpointer = SearchCheckpointer(args.checkpoint_dir, every=args.checkpoint_every)
+        if args.resume:
+            step = checkpointer.restore_into(algorithm, backend)
+            metrics.log("resume", step=step)
     try:
-        result = run_search(algorithm, backend, metrics=metrics)
+        result = run_search(algorithm, backend, metrics=metrics, checkpointer=checkpointer)
     finally:
         backend.close()
+        if checkpointer is not None:
+            checkpointer.close()
     best = result.best
     summary = {
         "workload": args.workload,

@@ -34,7 +34,15 @@ def run_search(
     backend: Backend,
     metrics: Optional[MetricsLogger] = None,
     max_batches: Optional[int] = None,
+    checkpointer=None,
 ) -> SearchResult:
+    """Drive the suggest→evaluate→report loop to completion.
+
+    ``checkpointer`` (utils.checkpoint.SearchCheckpointer) snapshots
+    algorithm + backend state after report_batch on its cadence, so a
+    killed process resumes at the last completed batch instead of
+    restarting the sweep.
+    """
     metrics = metrics or null_logger()
     t0 = time.perf_counter()
     batches = 0
@@ -61,6 +69,8 @@ def run_search(
             best_score=None if best is None else round(best.score, 6),
         )
         batches += 1
+        if checkpointer is not None:
+            checkpointer.maybe_save(batches, algorithm, backend)
         if max_batches is not None and batches >= max_batches:
             break
     wall = time.perf_counter() - t0

@@ -1,0 +1,108 @@
+"""Durable checkpoint/resume of search state (SURVEY.md §2 row 13, §5).
+
+The reference's failure model is MPI's: one rank dies, the gang dies,
+the sweep restarts from zero. The TPU-native recovery path is
+checkpoint-restart: the host-side search state (tiny JSON — trial
+ledger, algorithm bookkeeping, RNG counters) and the device-resident
+population state (params + momentum, the expensive thing to lose) are
+written together through orbax, and a restarted process resumes
+mid-sweep. In-flight trials at save time are re-dispatched on load by
+each algorithm's ``_requeue_running`` recovery (see algorithms/base.py).
+
+Layout: one orbax ``CheckpointManager`` step per completed driver batch,
+``max_to_keep`` most recent retained. Items:
+- ``search``: JSON — ``algorithm.state_dict()`` + backend host ledger.
+- ``pool``: pytree — the backend's device state (present only for
+  backends that carry one, i.e. the TPU population backend's slot pool).
+
+Saves are asynchronous (orbax's background thread) so the driver loop
+is never blocked on serialization of a multi-GB pool; ``close()`` (or
+the context manager) drains pending writes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import orbax.checkpoint as ocp
+
+
+class SearchCheckpointer:
+    """Periodic durable snapshots of (algorithm, backend) state."""
+
+    def __init__(self, directory: str, every: int = 1, keep: int = 2):
+        if every < 1:
+            raise ValueError(f"checkpoint every must be >= 1, got {every}")
+        self.directory = os.path.abspath(directory)
+        self.every = every
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep, create=True),
+        )
+
+    # -- save --------------------------------------------------------------
+
+    def maybe_save(self, step: int, algorithm, backend) -> bool:
+        """Save if ``step`` is on the cadence; returns whether it saved."""
+        if step % self.every:
+            return False
+        self.save(step, algorithm, backend)
+        return True
+
+    def save(self, step: int, algorithm, backend) -> None:
+        search = {
+            "algorithm": algorithm.state_dict(),
+            "backend": backend.host_state_dict(),
+        }
+        items = {"search": ocp.args.JsonSave(search)}
+        pool = backend.device_state()
+        if pool is not None:
+            items["pool"] = ocp.args.StandardSave(pool)
+        self._mgr.save(step, args=ocp.args.Composite(**items))
+
+    # -- restore -----------------------------------------------------------
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore_into(self, algorithm, backend) -> Optional[int]:
+        """Load the latest snapshot into a fresh algorithm/backend pair.
+
+        Returns the restored step, or None if the directory holds no
+        checkpoint (caller starts fresh).
+        """
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        items: dict[str, Any] = {"search": ocp.args.JsonRestore()}
+        has_pool = "pool" in self._item_names(step)
+        if has_pool:
+            items["pool"] = ocp.args.StandardRestore()
+        r = self._mgr.restore(step, args=ocp.args.Composite(**items))
+        algorithm.load_state_dict(r.search["algorithm"])
+        backend.load_host_state_dict(r.search["backend"])
+        if has_pool:
+            backend.load_device_state(r.pool)
+        return step
+
+    def _item_names(self, step: int) -> set:
+        try:
+            meta = self._mgr.item_metadata(step)
+            return set(meta.keys()) if hasattr(meta, "keys") else set()
+        except Exception:
+            # metadata probe is best-effort; fall back to directory list
+            step_dir = os.path.join(self.directory, str(step))
+            return set(os.listdir(step_dir)) if os.path.isdir(step_dir) else set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
