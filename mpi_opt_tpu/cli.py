@@ -56,6 +56,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="resume from the latest checkpoint in --checkpoint-dir "
         "(starts fresh if the directory is empty)",
     )
+    p.add_argument(
+        "--profile-dir",
+        default=None,
+        help="capture a jax.profiler trace of the search loop here "
+        "(TensorBoard-loadable)",
+    )
     # ASHA
     p.add_argument("--min-budget", type=int, default=10)
     p.add_argument("--max-budget", type=int, default=270)
@@ -129,8 +135,13 @@ def main(argv=None) -> int:
         if args.resume:
             step = checkpointer.restore_into(algorithm, backend)
             metrics.log("resume", step=step)
+    from mpi_opt_tpu.utils.profiling import profile_window
+
     try:
-        result = run_search(algorithm, backend, metrics=metrics, checkpointer=checkpointer)
+        with profile_window(args.profile_dir):
+            result = run_search(
+                algorithm, backend, metrics=metrics, checkpointer=checkpointer
+            )
     finally:
         backend.close()
         if checkpointer is not None:

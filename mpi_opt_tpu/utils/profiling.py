@@ -1,0 +1,48 @@
+"""Tracing/profiling hooks (SURVEY.md §5).
+
+``profile_window(dir)`` wraps a measured region in a ``jax.profiler``
+trace when a directory is given and is a zero-cost no-op otherwise, so
+callers sprinkle it unconditionally:
+
+    with profile_window(args.profile_dir):
+        run_search(...)
+
+The dump is TensorBoard-loadable (``xplane.pb`` under
+``<dir>/plugins/profile/<run>/``); on this container's tunneled TPU the
+device-side trace may be unavailable, in which case the host-side trace
+(dispatch gaps, transfer waits) still lands and a warning is printed
+rather than failing the run being measured.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+
+
+@contextlib.contextmanager
+def profile_window(directory=None):
+    if not directory:
+        yield
+        return
+    import jax
+
+    # guard only the trace start/stop: profiling must never kill (or
+    # mask an exception from) the run being measured
+    trace = None
+    try:
+        trace = jax.profiler.trace(str(directory))
+        trace.__enter__()
+    except Exception as e:
+        print(f"[profile] trace start failed ({type(e).__name__}: {e}); "
+              "continuing unprofiled", file=sys.stderr)
+        trace = None
+    try:
+        yield
+    finally:
+        if trace is not None:
+            try:
+                trace.__exit__(None, None, None)
+            except Exception as e:
+                print(f"[profile] trace stop failed ({type(e).__name__}: {e})",
+                      file=sys.stderr)

@@ -102,3 +102,36 @@ def test_shard_popstate_places_on_mesh(workload):
     leaf = jax.tree.leaves(sharded.params)[0]
     assert leaf.sharding == pop_sharding(mesh)
     assert len(leaf.devices()) == 8
+
+
+class TestInitializeMultihost:
+    """initialize_multihost is the config-5 bring-up shim; its contract:
+    single-process requests degrade gracefully, explicit multi-host
+    requests must never silently shrink to one process. In this test
+    process the XLA backend is already up, so every inner
+    jax.distributed.initialize raises — which is exactly the failure
+    path being pinned down."""
+
+    def test_single_process_swallows_late_init(self):
+        from mpi_opt_tpu.parallel.mesh import initialize_multihost
+
+        # no explicit world: failure to bring up distributed is fine,
+        # and the current process index comes back
+        assert initialize_multihost() == 0
+        assert initialize_multihost(num_processes=1) == 0
+
+    def test_explicit_coordinator_failure_raises(self):
+        from mpi_opt_tpu.parallel.mesh import initialize_multihost
+
+        with pytest.raises(RuntimeError):
+            initialize_multihost(
+                coordinator_address="127.0.0.1:1", num_processes=2, process_id=0
+            )
+
+    def test_explicit_world_size_failure_raises(self):
+        from mpi_opt_tpu.parallel.mesh import initialize_multihost
+
+        # num_processes>1 without a coordinator address is still an
+        # explicit multi-process request: must raise, not shrink
+        with pytest.raises(RuntimeError):
+            initialize_multihost(num_processes=2)

@@ -1,0 +1,31 @@
+"""Profiling hooks: trace capture and failure isolation."""
+
+import os
+
+import pytest
+
+from mpi_opt_tpu.utils.profiling import profile_window
+
+
+def test_profile_window_noop_without_dir():
+    with profile_window(None):
+        x = 1 + 1
+    assert x == 2
+
+
+def test_profile_window_captures_trace(tmp_path):
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "prof")
+    with profile_window(d):
+        (jnp.arange(128.0) ** 2).sum().block_until_ready()
+    found = []
+    for root, _, files in os.walk(d):
+        found += [f for f in files if f.endswith((".xplane.pb", ".trace.json.gz"))]
+    assert found, f"no trace artifacts under {d}"
+
+
+def test_profile_window_propagates_body_exception(tmp_path):
+    with pytest.raises(ValueError, match="boom"):
+        with profile_window(str(tmp_path / "p2")):
+            raise ValueError("boom")
