@@ -1,0 +1,19 @@
+import sys, time, jax, numpy as np
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_tpu")
+from mpi_opt_tpu.train.fused_pbt import fused_pbt
+from mpi_opt_tpu.workloads import get_workload
+wl = get_workload("cifar10_cnn")
+G, S = 2, 100
+for P in (32, 128, 256, 512):
+    try:
+        t0 = time.time()
+        r = fused_pbt(wl, population=P, generations=G, steps_per_gen=S, seed=0)
+        cold = time.time()-t0
+        r = None
+        t0 = time.time()
+        r = fused_pbt(wl, population=P, generations=G, steps_per_gen=S, seed=0)
+        dt = time.time()-t0
+        print(f"P={P}: cold {cold:.1f}s warm {dt:.2f}s -> {P*G/dt:.2f} member-gens/s", flush=True)
+        r = None
+    except Exception as e:
+        print(f"P={P} FAIL {type(e).__name__} {str(e)[:120]}", flush=True)
