@@ -1,25 +1,46 @@
-"""Headline benchmark: BASELINE config 3 (PBT, small CNN, CIFAR-10).
+"""Headline benchmark: the north-star PBT sweep (small CNN, CIFAR-10).
 
-Prints exactly ONE JSON line on stdout:
+Prints exactly ONE JSON line on stdout. Required keys:
     {"metric": ..., "value": N, "unit": "trials/sec/chip", "vs_baseline": N}
+plus honesty/utilization extras: mfu, flops accounting, BOTH baseline
+normalizations, and wall-clock-to-target-accuracy (the second metric of
+record in BASELINE.json).
 
 Unit of work ("trial") = one PBT member-generation: steps_per_gen
 training steps + a full validation eval for one population member.
-Both sides do identical work on identical shapes:
+Both sides do identical work on identical shapes.
 
 - TPU side: the fused on-device PBT sweep (train/fused_pbt.py) —
   population x generations member-generations in one XLA program on
-  the real chip. A structurally-identical warmup run (1 generation)
+  the real chip. A structurally-identical warmup run (same static args)
   populates the compile cache first so the measurement is steady-state
   throughput, which is what a >1-generation sweep experiences.
+  The default population is 256 — the north-star sweep size
+  (BASELINE.json: "256-member PBT CIFAR-10 CNN sweep").
+
 - Baseline: the CPU process-pool backend evaluating the same member-
   generations — one process per trial, the same execution model as the
   reference's per-rank MPI workers (no MPI exists in this container;
   see BASELINE.md — the reference itself has no published numbers).
-  The pool is warmed with a 1-step round first so worker spawn/import
-  time is excluded; the baseline gets its batch-parallelism for free.
+  The pool is warmed first so worker spawn/import/compile time is
+  excluded.
 
-vs_baseline = tpu_trials_per_sec / cpu_trials_per_sec_per_worker_pool.
+Baseline normalizations (both reported; the headline ``vs_baseline`` is
+the HONEST one):
+- ``vs_baseline`` / ``vs_8rank_equiv``: TPU throughput vs an 8-rank
+  pool extrapolated LINEARLY from the measured per-process rate
+  (8 x per-proc trials/sec). This box has os.cpu_count()=1, so a real
+  8-worker pool would timeshare one core; linear extrapolation is the
+  generous-to-the-baseline stand-in for the north star's "8-rank MPI",
+  assuming perfect scaling and zero MPI overhead.
+- ``vs_measured_pool``: TPU throughput vs the pool as actually measured
+  on this box (the round-1 number's definition).
+
+MFU: sweep FLOPs (composed from single-trip XLA cost-analysis pieces —
+see utils/flops.py for why whole-program counts can't be trusted)
+divided by (wall x chip bf16 peak), and also divided by the *measured*
+matmul cap of this device (tunneled chips deliver far below nominal;
+see PERF_NOTES.md).
 """
 
 from __future__ import annotations
@@ -35,35 +56,113 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def bench_tpu(population, generations, steps, seed):
+def bench_tpu(args):
     import jax
 
     jax.config.update(
         "jax_compilation_cache_dir",
         "/tmp/jax_cache_tpu" if jax.default_backend() != "cpu" else "/tmp/jax_cache_cpu",
     )
-    from mpi_opt_tpu.ops.pbt import PBTConfig
     from mpi_opt_tpu.train.fused_pbt import fused_pbt
+    from mpi_opt_tpu.utils.flops import mfu, population_sweep_flops
+    from mpi_opt_tpu.utils.profiling import profile_window
     from mpi_opt_tpu.workloads import get_workload
 
     wl = get_workload("cifar10_cnn")
+    population, generations, steps = args.population, args.generations, args.steps
     log(f"[bench] tpu side: backend={jax.default_backend()} pop={population} "
-        f"gens={generations} steps={steps}")
+        f"gens={generations} steps={steps} member_chunk={args.member_chunk} "
+        f"gen_chunk={args.gen_chunk}")
+    kw = dict(
+        population=population,
+        generations=generations,
+        steps_per_gen=steps,
+        seed=args.seed,
+        member_chunk=args.member_chunk,
+        gen_chunk=args.gen_chunk,
+    )
     # warmup is an IDENTICAL invocation: generations is a static jit arg
     # (scan length), so only the same-arg call guarantees the measured
     # run is a pure cache hit / steady-state execution
     t0 = time.perf_counter()
-    fused_pbt(wl, population=population, generations=generations, steps_per_gen=steps, seed=seed)
+    fused_pbt(wl, **kw)
     log(f"[bench] warmup (compile+run) {time.perf_counter()-t0:.1f}s")
-    t0 = time.perf_counter()
-    result = fused_pbt(
-        wl, population=population, generations=generations, steps_per_gen=steps, seed=seed
-    )
-    wall = time.perf_counter() - t0
+    with profile_window(args.profile_dir):
+        t0 = time.perf_counter()
+        result = fused_pbt(wl, **kw)
+        wall = time.perf_counter() - t0
     trials = population * generations
-    log(f"[bench] tpu: {trials} member-gens in {wall:.2f}s -> "
-        f"{trials/wall:.3f} trials/s/chip; best={result['best_score']:.3f}")
-    return trials / wall
+    tps = trials / wall
+    # flops accounting AFTER the timed window (it lowers/compiles tiny
+    # one-member programs — that must not count against the sweep)
+    flops = population_sweep_flops(
+        wl, population, generations, steps, n_evals=generations
+    )
+
+    # wall-clock to target val-acc (metric of record #2)
+    from mpi_opt_tpu.utils.metrics import wall_to_target as _wtt
+
+    curve = [float(v) for v in result["best_curve"]]
+    wall_to_target = _wtt(curve, wall, args.target_acc)
+
+    util = mfu(flops, wall, jax.devices()[0])
+    cap_tf = measure_platform_cap() if jax.default_backend() == "tpu" else None
+    log(f"[bench] tpu: {trials} member-gens in {wall:.2f}s -> {tps:.3f} trials/s/chip; "
+        f"best={result['best_score']:.3f} curve={[round(v, 3) for v in curve]}")
+    if flops:
+        log(f"[bench] flops={flops:.3e} ({flops/wall/1e12:.1f} TFLOP/s, "
+            f"mfu={'-' if util is None else round(util, 4)} of nominal peak, "
+            f"platform cap {cap_tf and round(cap_tf, 1)} TF/s)")
+    return {
+        "platform_matmul_tflops": round(cap_tf, 1) if cap_tf else None,
+        "mfu_vs_platform_cap": (
+            round(flops / wall / 1e12 / cap_tf, 4) if flops and cap_tf else None
+        ),
+        "tps": tps,
+        "wall": wall,
+        "best": float(result["best_score"]),
+        "curve": curve,
+        "wall_to_target": wall_to_target,
+        "flops": flops,
+        "mfu": util,
+        "device": jax.devices()[0].device_kind,
+    }
+
+
+def measure_platform_cap(iters=8):
+    """Measured matmul throughput cap of THIS device (TF/s).
+
+    bf16 4096^3 matmuls chained inside one program — ideal MXU shapes,
+    ~1.1 TFLOP per dispatch so tunnel dispatch overhead is noise. On
+    nominal hardware this approaches the datasheet peak; on virtualized
+    /tunneled devices it is the *real* ceiling (measured 2026-07-30 on
+    this container's tunneled v5e: 64.8 TF/s vs 394 nominal), and MFU
+    against nominal peak alone would wildly understate how much of the
+    attainable machine the sweep uses. Reported alongside nominal-peak
+    MFU, never instead of it.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    M = 4096
+    a = jax.random.normal(jax.random.key(0), (M, M), jnp.bfloat16)
+    b = jax.random.normal(jax.random.key(1), (M, M), jnp.bfloat16) * 0.01
+
+    @jax.jit
+    def step(b):
+        for _ in range(8):
+            b = (a @ b) * 1e-3
+        return b.astype(jnp.bfloat16)
+
+    b1 = step(b)
+    np.asarray(b1[0, 0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        b1 = step(b1)
+    np.asarray(b1[0, 0])
+    dt = (time.perf_counter() - t0) / iters
+    return 8 * 2 * M**3 / dt / 1e12
 
 
 def bench_cpu_baseline(steps, seed, n_workers):
@@ -104,38 +203,73 @@ def bench_cpu_baseline(steps, seed, n_workers):
     be.evaluate(make_trials(1000, steps))
     wall = time.perf_counter() - t0
     be.close()
+    pool_tps = n_workers / wall
     log(f"[bench] cpu: {n_workers} member-gens in {wall:.2f}s -> "
-        f"{n_workers/wall:.4f} trials/s ({n_workers} procs)")
-    return n_workers / wall
+        f"{pool_tps:.4f} trials/s ({n_workers} procs)")
+    return pool_tps
 
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--population", type=int, default=32)
+    p.add_argument("--population", type=int, default=256)
     p.add_argument("--generations", type=int, default=4)
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--member-chunk", type=int, default=32)
+    p.add_argument(
+        "--gen-chunk",
+        type=int,
+        default=1,
+        help="generations per program launch (tunneled chips kill >60s programs)",
+    )
+    p.add_argument("--target-acc", type=float, default=0.70)
     p.add_argument("--workers", type=int, default=min(8, os.cpu_count() or 8))
     p.add_argument("--skip-baseline", action="store_true")
+    p.add_argument("--profile-dir", default=None)
     args = p.parse_args()
 
-    tpu_tps = bench_tpu(args.population, args.generations, args.steps, args.seed)
+    tpu = bench_tpu(args)
+    record = {
+        "metric": "pbt_cifar10_cnn_member_generations_per_sec_per_chip",
+        "value": round(tpu["tps"], 4),
+        "unit": "trials/sec/chip",
+        "population": args.population,
+        "generations": args.generations,
+        "steps_per_gen": args.steps,
+        "device": tpu["device"],
+        "best_val_acc": round(tpu["best"], 4),
+        "target_acc": args.target_acc,
+        "wall_to_target_s": (
+            round(tpu["wall_to_target"], 2) if tpu["wall_to_target"] is not None else None
+        ),
+        "flops_total": tpu["flops"],
+        "tflops_per_sec": (
+            round(tpu["flops"] / tpu["wall"] / 1e12, 2) if tpu["flops"] else None
+        ),
+        "mfu": round(tpu["mfu"], 4) if tpu["mfu"] is not None else None,
+        "platform_matmul_tflops": tpu["platform_matmul_tflops"],
+        "mfu_vs_platform_cap": tpu["mfu_vs_platform_cap"],
+    }
     if args.skip_baseline:
-        cpu_tps = None
-        vs = 1.0
+        record["vs_baseline"] = 1.0
+        record["baseline"] = "skipped"
     else:
-        cpu_tps = bench_cpu_baseline(args.steps, args.seed, args.workers)
-        vs = tpu_tps / cpu_tps
-    print(
-        json.dumps(
-            {
-                "metric": "pbt_cifar10_cnn_member_generations_per_sec_per_chip",
-                "value": round(tpu_tps, 4),
-                "unit": "trials/sec/chip",
-                "vs_baseline": round(vs, 2),
-            }
+        pool_tps = bench_cpu_baseline(args.steps, args.seed, args.workers)
+        per_proc = pool_tps / args.workers
+        rank8 = 8.0 * per_proc
+        record["cpu_pool_workers"] = args.workers
+        record["cpu_pool_trials_per_sec"] = round(pool_tps, 4)
+        record["vs_measured_pool"] = round(tpu["tps"] / pool_tps, 2)
+        record["vs_8rank_equiv"] = round(tpu["tps"] / rank8, 2)
+        # the headline number is the HONEST normalization: vs an 8-rank
+        # pool extrapolated linearly from the measured per-process rate
+        record["vs_baseline"] = record["vs_8rank_equiv"]
+        record["baseline"] = (
+            f"8-rank equivalent = 8 x measured per-process CPU rate "
+            f"({per_proc:.4f} trials/s/proc, {args.workers}-proc pool, "
+            f"cpu_count={os.cpu_count()})"
         )
-    )
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -50,6 +50,16 @@ class TPEConfig:
     # unexplored regions (whose acquisition log((nb+1)/(ng+1)) > 0 is
     # competitive) are never even scored.
     uniform_frac: float = 0.1
+    # Batched-suggest diversity. This framework's suggest batches are
+    # population-sized; a plain top-k of one candidate set returns
+    # near-duplicates from the acquisition's strongest mode (k similar
+    # trials = k-1 wasted evaluations). With diversify_bw > 0 selection
+    # is greedy-with-repulsion: after each pick, candidates within
+    # ~diversify_bw (unit space) are penalized by a Gaussian bump of
+    # height diversify_weight (in acquisition log-units), so later
+    # picks come from distinct modes. n_suggest=1 is unaffected.
+    diversify_bw: float = 0.1
+    diversify_weight: float = 5.0
 
 
 def _masked_moments(x, w):
@@ -133,5 +143,29 @@ def tpe_suggest(
     acq = _log_mixture(cand, obs_unit, good_w, bw_g, cfg.prior_weight) - _log_mixture(
         cand, obs_unit, bad_w, bw_b, cfg.prior_weight
     )
+    if n_suggest > 1 and cfg.diversify_bw > 0:
+        top_idx = _diverse_top_k(cand, acq, n_suggest, cfg.diversify_bw, cfg.diversify_weight)
+        return cand[top_idx], acq[top_idx]
     top_acq, top_idx = jax.lax.top_k(acq, n_suggest)
     return cand[top_idx], top_acq
+
+
+def _diverse_top_k(cand, acq, k: int, bw: float, weight: float):
+    """Greedy diversified selection: argmax, repel, repeat.
+
+    A scan of k steps over the [C] acquisition vector; each pick
+    subtracts a Gaussian repulsion (height ``weight``, width ``bw`` in
+    unit space) around itself, so the running argmax walks distinct
+    acquisition modes instead of re-picking one mode's shoulder.
+    Returns int32[k] candidate indices (first pick == plain argmax).
+    """
+
+    def pick(acq_cur, _):
+        i = jnp.argmax(acq_cur)
+        d2 = ((cand - cand[i]) ** 2).sum(-1)
+        penalty = weight * jnp.exp(-0.5 * d2 / (bw * bw))
+        acq_cur = (acq_cur - penalty).at[i].set(-jnp.inf)
+        return acq_cur, i
+
+    _, idx = jax.lax.scan(pick, acq, None, length=k)
+    return idx

@@ -55,7 +55,15 @@ def run_fused_pbt(
     steps_per_gen: int = 100,
     cfg: PBTConfig = PBTConfig(),
 ):
-    """Returns (state, unit, best_curve[G], mean_curve[G], final_scores[P])."""
+    """Returns (state, unit, key', best_curve[G], mean_curve[G], final_scores[P]).
+
+    ``key'`` is the scan-carried RNG key after ``generations`` steps of
+    the chain — feeding it into a following call continues the EXACT
+    trajectory one longer call would have taken, which is what makes
+    ``gen_chunk`` launch-splitting bit-identical to a single launch.
+    """
+    if generations < 1:  # static arg: raises at trace time, not opaquely later
+        raise ValueError(f"generations must be >= 1, got {generations}")
     disc = jnp.asarray(discrete_mask, dtype=bool)
 
     def one_generation(carry, g):
@@ -66,13 +74,15 @@ def run_fused_pbt(
         scores = trainer.eval_population(st, val_x, val_y)
         new_u, src_idx, _ = pbt_exploit_explore(k_pbt, u, scores, disc, cfg)
         st = trainer.gather_members(st, src_idx)
-        return (st, new_u, k), (scores.max(), scores.mean())
+        # the post-exploit population's scores are exactly the gathered
+        # pre-exploit scores (weights are copied verbatim, eval is
+        # deterministic) — so no final re-eval is ever needed
+        return (st, new_u, k), (scores.max(), scores.mean(), scores[src_idx])
 
-    (state, unit, _), (best, mean) = jax.lax.scan(
+    (state, unit, key), (best, mean, gen_scores) = jax.lax.scan(
         one_generation, (state, unit, key), jnp.arange(generations)
     )
-    final_scores = trainer.eval_population(state, val_x, val_y)
-    return state, unit, best, mean, final_scores
+    return state, unit, key, best, mean, gen_scores[-1]
 
 
 def fused_pbt(
@@ -84,17 +94,37 @@ def fused_pbt(
     cfg: PBTConfig = PBTConfig(),
     mesh=None,
     member_chunk: int = 0,
+    gen_chunk: int = 0,
 ):
     """Convenience wrapper: run a whole PBT sweep for a vision-style
     workload; optionally sharded over a ``('pop','data')`` mesh.
 
     Returns a result dict with the best member's hparams and curves.
+    (For FLOPs/MFU accounting of a sweep, call
+    ``utils.flops.population_sweep_flops`` OUTSIDE any timed window —
+    it lowers tiny probe programs, which must not count against a
+    measurement; see bench.py.)
+
+    ``gen_chunk`` splits the sweep into ceil(G/gen_chunk) launches
+    (0 = whole sweep in one launch), sized near-equally so at most TWO
+    distinct launch lengths exist — i.e. at most two compiled programs,
+    exactly one when gen_chunk divides G. The population and the
+    scan-carried RNG key thread through launches on-device, so a
+    chunked sweep is BIT-IDENTICAL to a single launch (tested) and the
+    steady-state cost is ~ms of dispatch per chunk. This exists because
+    some environments bound single-program execution time (this
+    container's tunneled TPU kills programs running longer than ~60s —
+    measured 2026-07-30: pop=128 x 4 gens x 100 steps survives, 8 gens
+    does not), and because big-G scans compile slower for no runtime
+    benefit: generations are identical program text.
     """
     import numpy as np
 
     from mpi_opt_tpu.parallel.mesh import replicate, shard_popstate
     from mpi_opt_tpu.train.common import workload_arrays
 
+    if generations < 1:  # before any data/device work
+        raise ValueError(f"generations must be >= 1, got {generations}")
     trainer, space, train_x, train_y, val_x, val_y = workload_arrays(
         workload, member_chunk, mesh
     )
@@ -115,21 +145,39 @@ def fused_pbt(
     # workload cache above so its identity is stable across calls
     hparams_fn = _HParamsFn(space, workload)
 
-    state, unit, best, mean, final_scores = run_fused_pbt(
-        trainer,
-        state,
-        unit,
-        hparams_fn,
-        train_x=train_x,
-        train_y=train_y,
-        val_x=val_x,
-        val_y=val_y,
-        key=k_run,
-        discrete_mask=tuple(bool(b) for b in space.discrete_mask()),
-        generations=generations,
-        steps_per_gen=steps_per_gen,
-        cfg=cfg,
-    )
+    disc = tuple(bool(b) for b in space.discrete_mask())
+    g_chunk = generations if gen_chunk <= 0 else min(gen_chunk, generations)
+    # balanced split: ceil(G/chunk) launches whose lengths differ by at
+    # most 1 (e.g. G=3, chunk=2 -> [2, 1]; G=7, chunk=3 -> [3, 2, 2]),
+    # so a non-dividing chunk costs one extra compile, never more
+    n_launches = -(-generations // g_chunk)
+    base, rem = divmod(generations, n_launches)
+    launch_lens = [base + 1] * rem + [base] * (n_launches - rem)
+
+    best_parts, mean_parts = [], []
+    for g in launch_lens:
+        # k_run is the scan-carried key returned by the previous launch:
+        # the chain continues exactly as one longer scan would have
+        state, unit, k_run, best, mean, final_scores = run_fused_pbt(
+            trainer,
+            state,
+            unit,
+            hparams_fn,
+            train_x=train_x,
+            train_y=train_y,
+            val_x=val_x,
+            val_y=val_y,
+            key=k_run,
+            discrete_mask=disc,
+            generations=g,
+            steps_per_gen=steps_per_gen,
+            cfg=cfg,
+        )
+        best_parts.append(best)
+        mean_parts.append(mean)
+    best = jnp.concatenate(best_parts)
+    mean = jnp.concatenate(mean_parts)
+
     scores = np.asarray(final_scores)
     best_i = int(scores.argmax())
     return {

@@ -61,3 +61,20 @@ def null_logger() -> MetricsLogger:
 
 def stdout_logger(path: Optional[str] = None, n_chips: int = 1) -> MetricsLogger:
     return MetricsLogger(path=path, stream=sys.stdout, n_chips=n_chips)
+
+
+def wall_to_target(curve, wall_s: float, target: float):
+    """Prorated wall-clock (seconds) until a per-generation best-score
+    curve first reaches ``target``; None if it never does.
+
+    The metric-of-record definition (BASELINE.json: "wall-clock to
+    target validation accuracy"): generations are uniform work, so
+    reaching the target at generation g costs (g+1)/G of the sweep's
+    wall. Single-sourced here so every bench compares raw float curve
+    values against the target identically.
+    """
+    curve = [float(v) for v in curve]
+    for g, v in enumerate(curve):
+        if v >= target:
+            return wall_s * (g + 1) / len(curve)
+    return None

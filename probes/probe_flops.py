@@ -1,0 +1,19 @@
+import jax, jax.numpy as jnp
+import numpy as np
+
+def cost(fn, *args):
+    c = jax.jit(fn).lower(*args).compile().cost_analysis()
+    if isinstance(c, (list, tuple)): c = c[0]
+    return c.get("flops")
+
+A = jnp.zeros((1024, 1024), jnp.bfloat16); B = jnp.zeros((1024, 1024), jnp.bfloat16)
+print("matmul flops:", cost(lambda a, b: a @ b, A, B), "expected 2.15e9")
+x = jnp.zeros((256, 32, 32, 32), jnp.bfloat16)
+w = jnp.zeros((3, 3, 32, 32), jnp.bfloat16)
+conv = lambda x, w: jax.lax.conv_general_dilated(x, w, (1,1), "SAME", dimension_numbers=("NHWC","HWIO","NHWC"))
+print("conv flops:", cost(conv, x, w), "expected 4.8e9")
+# vmapped conv over 8 members
+wv = jnp.zeros((8, 3, 3, 32, 32), jnp.bfloat16)
+xv = jnp.zeros((8, 256, 32, 32, 32), jnp.bfloat16)
+vconv = jax.vmap(conv)
+print("vmap conv flops:", cost(vconv, xv, wv), "expected 3.9e10")

@@ -1,0 +1,46 @@
+"""FLOPs/MFU accounting (utils/flops.py)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mpi_opt_tpu.utils.flops import (
+    compiled_flops,
+    mfu,
+    peak_flops_per_chip,
+    population_sweep_flops,
+)
+
+
+def test_compiled_flops_matmul_exact():
+    a = jnp.zeros((256, 256), jnp.float32)
+    f = compiled_flops(jax.jit(lambda a, b: a @ b), a, a)
+    if f is None:
+        pytest.skip("cost analysis unavailable on this backend")
+    assert f == pytest.approx(2 * 256**3, rel=0.01)
+
+
+def test_population_sweep_flops_linear_scaling():
+    from mpi_opt_tpu.workloads import get_workload
+
+    wl = get_workload("fashion_mlp", n_train=256, n_val=128)
+    f1 = population_sweep_flops(wl, population=4, generations=2, steps_per_gen=3, n_evals=3)
+    if f1 is None:
+        pytest.skip("cost analysis unavailable on this backend")
+    f2 = population_sweep_flops(wl, population=8, generations=2, steps_per_gen=3, n_evals=3)
+    assert f1 > 0
+    # flops are exactly linear in population (same evals per member)
+    assert f2 == pytest.approx(2 * f1, rel=1e-6)
+    # more steps -> strictly more flops, sublinear total (evals fixed)
+    f3 = population_sweep_flops(wl, population=4, generations=2, steps_per_gen=6, n_evals=3)
+    assert f1 < f3 < 2 * f1
+
+
+def test_peak_and_mfu_off_tpu_is_none():
+    dev = jax.devices()[0]
+    if dev.platform == "tpu":
+        assert peak_flops_per_chip(dev) is not None
+        assert 0 < mfu(1e12, 1.0, dev) < 1
+    else:
+        assert peak_flops_per_chip(dev) is None
+        assert mfu(1e12, 1.0, dev) is None

@@ -104,3 +104,35 @@ def test_member_chunk_matches_full_vmap(setup):
     b, _ = chunked.train_segment(st, hp, data["train_x"], data["train_y"], jax.random.key(9), 10)
     la, lb = np.asarray(jax.tree.leaves(a.params)[0]), np.asarray(jax.tree.leaves(b.params)[0])
     np.testing.assert_allclose(la, lb, rtol=2e-2, atol=2e-5)  # bf16 tolerance
+
+
+def test_fused_pbt_gen_chunked_launches():
+    """gen_chunk is pure launch-splitting: population state AND the
+    scan-carried RNG key thread through launches, so a chunked sweep
+    must be BIT-IDENTICAL to the single-launch sweep — same curves,
+    same final scores, same winning hparams."""
+    import numpy as np
+
+    from mpi_opt_tpu.train.fused_pbt import fused_pbt
+    from mpi_opt_tpu.workloads import get_workload
+
+    wl = get_workload("fashion_mlp", n_train=512, n_val=256)
+    kw = dict(population=8, generations=3, steps_per_gen=10, seed=0)
+    whole = fused_pbt(wl, gen_chunk=0, **kw)
+    chunked = fused_pbt(wl, gen_chunk=2, **kw)  # balanced split [2, 1]
+    assert chunked["best_curve"].shape == (3,)
+    np.testing.assert_array_equal(chunked["best_curve"], whole["best_curve"])
+    np.testing.assert_array_equal(chunked["mean_curve"], whole["mean_curve"])
+    np.testing.assert_array_equal(chunked["unit"], whole["unit"])
+    assert chunked["best_score"] == whole["best_score"]
+
+
+def test_fused_pbt_rejects_zero_generations():
+    import pytest
+
+    from mpi_opt_tpu.train.fused_pbt import fused_pbt
+    from mpi_opt_tpu.workloads import get_workload
+
+    wl = get_workload("fashion_mlp", n_train=256, n_val=128)
+    with pytest.raises(ValueError, match="generations"):
+        fused_pbt(wl, population=4, generations=0, steps_per_gen=5)
