@@ -6,6 +6,7 @@ CLI (SURVEY.md §1 CLI layer; reference unreadable).
 
 from mpi_opt_tpu.algorithms.asha import ASHA
 from mpi_opt_tpu.algorithms.base import Algorithm
+from mpi_opt_tpu.algorithms.hyperband import Hyperband
 from mpi_opt_tpu.algorithms.pbt import PBT
 from mpi_opt_tpu.algorithms.random_search import RandomSearch
 from mpi_opt_tpu.algorithms.tpe import TPE
@@ -15,6 +16,7 @@ ALGORITHMS: dict[str, type[Algorithm]] = {
     ASHA.name: ASHA,
     PBT.name: PBT,
     TPE.name: TPE,
+    Hyperband.name: Hyperband,
 }
 
 
@@ -27,4 +29,13 @@ def get_algorithm(name: str) -> type[Algorithm]:
         ) from None
 
 
-__all__ = ["Algorithm", "RandomSearch", "ASHA", "PBT", "TPE", "ALGORITHMS", "get_algorithm"]
+__all__ = [
+    "Algorithm",
+    "RandomSearch",
+    "ASHA",
+    "Hyperband",
+    "PBT",
+    "TPE",
+    "ALGORITHMS",
+    "get_algorithm",
+]

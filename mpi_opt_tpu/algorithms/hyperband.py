@@ -1,0 +1,126 @@
+"""Hyperband: brackets of successive halving over a budget grid.
+
+Li et al. 2018. ASHA (this package's `algorithms.asha`) is the
+asynchronous core of one bracket; Hyperband hedges ASHA's single
+aggressiveness setting by running `s_max+1` brackets that trade number
+of configurations against starting budget — bracket s starts
+`ceil((s_max+1)/(s+1) * eta^s)` trials at budget `R * eta^-s`.
+
+Composition design: each bracket IS an `ASHA` instance (same promotion
+rule, same checkpoint recovery); Hyperband runs them sequentially and
+aggregates. This keeps one source of truth for the halving logic — the
+driver contract, requeue-on-resume behavior, and the on-device
+`ops.asha_cut` path all come along for free. With R=81, eta=3 the
+bracket plan is the paper's Table 1: (81@1, 34@3, 15@9, 8@27, 5@81).
+
+The fused on-device variant is `train.fused_asha.fused_hyperband`.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from mpi_opt_tpu.algorithms.asha import ASHA
+from mpi_opt_tpu.algorithms.base import Algorithm
+from mpi_opt_tpu.space import SearchSpace
+from mpi_opt_tpu.trial import TrialResult
+
+
+def bracket_plan(max_budget: int, eta: int) -> list[tuple[int, int]]:
+    """[(n_trials, start_budget)] per bracket, most-exploratory first."""
+    # s_max = floor(log_eta(R)) by integer division: float log loses a
+    # whole bracket when R is an exact eta power (log3(243) computes as
+    # 4.999...), silently dropping the most-exploratory bracket
+    s_max, b = 0, max_budget
+    while b >= eta:
+        b //= eta
+        s_max += 1
+    plan = []
+    for s in range(s_max, -1, -1):
+        n = int(np.ceil((s_max + 1) / (s + 1) * eta**s))
+        r = max(1, round(max_budget / eta**s))
+        plan.append((n, r))
+    return plan
+
+
+class Hyperband(Algorithm):
+    name = "hyperband"
+
+    def __init__(
+        self,
+        space: SearchSpace,
+        seed: int = 0,
+        max_budget: int = 81,
+        eta: int = 3,
+    ):
+        super().__init__(space, seed)
+        self.eta = eta
+        self.max_budget = max_budget
+        self.brackets = [
+            ASHA(
+                space,
+                # decorrelate bracket sampling; deterministic per bracket
+                seed=seed + 7919 * b,
+                max_trials=n,
+                min_budget=r,
+                max_budget=max_budget,
+                eta=eta,
+            )
+            for b, (n, r) in enumerate(bracket_plan(max_budget, eta))
+        ]
+        self._cur = 0
+
+    # -- contract ---------------------------------------------------------
+
+    def _current(self) -> ASHA | None:
+        while self._cur < len(self.brackets) and self.brackets[self._cur].finished():
+            self._cur += 1
+        return self.brackets[self._cur] if self._cur < len(self.brackets) else None
+
+    def next_batch(self, n):
+        b = self._current()
+        return [] if b is None else b.next_batch(n)
+
+    def report_batch(self, results: Sequence[TrialResult]):
+        # brackets run sequentially, so outstanding results always
+        # belong to the bracket that is current right now
+        self.brackets[self._cur].report_batch(results)
+
+    def finished(self):
+        return self._current() is None
+
+    # -- aggregation across brackets --------------------------------------
+
+    def best(self):
+        bests = [b.best() for b in self.brackets]
+        bests = [t for t in bests if t is not None]
+        return max(bests, key=lambda t: t.score) if bests else None
+
+    @property
+    def n_trials(self) -> int:
+        return sum(b.n_trials for b in self.brackets)
+
+    # -- checkpoint -------------------------------------------------------
+
+    def state_dict(self):
+        return {
+            "hyperband": {
+                "cur": self._cur,
+                "max_budget": self.max_budget,
+                "eta": self.eta,
+                "brackets": [b.state_dict() for b in self.brackets],
+            }
+        }
+
+    def load_state_dict(self, state):
+        h = state["hyperband"]
+        if h["max_budget"] != self.max_budget or h["eta"] != self.eta:
+            raise ValueError(
+                f"checkpoint is for hyperband(R={h['max_budget']}, eta={h['eta']}), "
+                f"not (R={self.max_budget}, eta={self.eta})"
+            )
+        self._cur = h["cur"]
+        for b, s in zip(self.brackets, h["brackets"]):
+            b.load_state_dict(s)

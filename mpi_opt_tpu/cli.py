@@ -71,6 +71,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--generations", type=int, default=10)
     p.add_argument("--steps-per-generation", type=int, default=200)
     p.add_argument("--truncation", type=float, default=0.25)
+    # fused on-device sweeps (train/fused_pbt.py, train/fused_asha.py)
+    p.add_argument(
+        "--fused",
+        action="store_true",
+        help="run the whole sweep on-device (pbt/asha/hyperband): no "
+        "driver round-trips, population never leaves the device; for "
+        "pbt, --checkpoint-dir makes it crash-recoverable at launch "
+        "granularity",
+    )
+    p.add_argument(
+        "--member-chunk",
+        type=int,
+        default=0,
+        help="fused: process members in chunks of this size "
+        "(activation-memory relief for big populations)",
+    )
+    p.add_argument(
+        "--gen-chunk",
+        type=int,
+        default=0,
+        help="fused pbt: generations per program launch (bit-identical "
+        "split; needed where single programs are time-limited)",
+    )
     return p
 
 
@@ -89,6 +112,8 @@ def make_algorithm(args, space):
             max_budget=args.max_budget,
             eta=args.eta,
         )
+    if args.algorithm == "hyperband":
+        return cls(space, seed=args.seed, max_budget=args.max_budget, eta=args.eta)
     if args.algorithm == "pbt":
         return cls(
             space,
@@ -101,12 +126,107 @@ def make_algorithm(args, space):
     raise AssertionError(args.algorithm)
 
 
+def run_fused(args, parser, workload) -> int:
+    """--fused: the whole sweep as on-device programs, no driver loop.
+
+    PBT maps to train.fused_pbt (generation scan, exploit/explore and
+    winner gathers on-device, optional crash-recovery snapshots); ASHA
+    maps to train.fused_asha (synchronous successive halving, rung cuts
+    as on-device top_k). Emits the same summary JSON shape as the
+    driver path so downstream tooling doesn't care which path ran.
+    """
+    import time
+
+    from mpi_opt_tpu.utils.profiling import profile_window
+    from mpi_opt_tpu.workloads.base import PopulationWorkload
+
+    if not isinstance(workload, PopulationWorkload):
+        parser.error(f"--fused requires a population workload, not {args.workload!r}")
+    if args.checkpoint_dir and args.algorithm != "pbt":
+        # a silent no-op here would betray the crash-recovery promise
+        parser.error(
+            "--checkpoint-dir with --fused is only supported for pbt "
+            "(fused asha/hyperband sweeps have no snapshot support yet)"
+        )
+    import jax
+
+    n_chips = jax.local_device_count()
+    metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
+    t0 = time.perf_counter()
+    with profile_window(args.profile_dir):
+        if args.algorithm == "pbt":
+            from mpi_opt_tpu.train.fused_pbt import fused_pbt
+
+            res = fused_pbt(
+                workload,
+                population=args.population,
+                generations=args.generations,
+                steps_per_gen=args.steps_per_generation,
+                seed=args.seed,
+                cfg=PBTConfig(truncation_frac=args.truncation),
+                member_chunk=args.member_chunk,
+                gen_chunk=args.gen_chunk,
+                checkpoint_dir=args.checkpoint_dir,
+                snapshot_every=args.checkpoint_every,
+            )
+            n_trials = args.population * args.generations
+            extra = {"best_curve": [round(float(v), 4) for v in res["best_curve"]]}
+        elif args.algorithm == "asha":
+            from mpi_opt_tpu.train.fused_asha import fused_sha
+
+            res = fused_sha(
+                workload,
+                n_trials=args.trials,
+                min_budget=args.min_budget,
+                max_budget=args.max_budget,
+                eta=args.eta,
+                seed=args.seed,
+                member_chunk=args.member_chunk,
+            )
+            n_trials = res["n_trials"]
+            extra = {"rung_sizes": res["rung_sizes"], "rung_budgets": res["rung_budgets"]}
+        elif args.algorithm == "hyperband":
+            from mpi_opt_tpu.train.fused_asha import fused_hyperband
+
+            res = fused_hyperband(
+                workload,
+                max_budget=args.max_budget,
+                eta=args.eta,
+                seed=args.seed,
+                member_chunk=args.member_chunk,
+            )
+            n_trials = res["n_trials"]
+            extra = {"brackets": res["brackets"]}
+        else:
+            parser.error(f"--fused supports pbt/asha/hyperband, not {args.algorithm!r}")
+    wall = time.perf_counter() - t0
+    metrics.count_trials(n_trials)
+    summary = {
+        "workload": args.workload,
+        "algorithm": args.algorithm,
+        "backend": "fused",
+        "n_trials": n_trials,
+        "wall_s": round(wall, 3),
+        "trials_per_sec_per_chip": round(n_trials / max(wall, 1e-9) / n_chips, 4),
+        "best_score": round(res["best_score"], 6),
+        "best_params": {
+            k: v for k, v in res["best_params"].items() if not k.startswith("__")
+        },
+        **extra,
+    }
+    metrics.summary(**{"final": True})
+    print(json.dumps(summary))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.resume and not args.checkpoint_dir:
         parser.error("--resume requires --checkpoint-dir")
     workload = get_workload(args.workload)
+    if args.fused:
+        return run_fused(args, parser, workload)
     space = workload.default_space()
     algorithm = make_algorithm(args, space)
     backend_kwargs = {}

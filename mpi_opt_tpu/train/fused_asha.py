@@ -140,3 +140,60 @@ def fused_sha(
         "last_score": last_score,
         "n_trials": n_trials,
     }
+
+
+def fused_hyperband(
+    workload,
+    max_budget: int = 270,
+    eta: int = 3,
+    seed: int = 0,
+    member_chunk: int = 0,
+    mesh=None,
+    round_to: int = 1,
+):
+    """Hyperband with every bracket running as a fused on-device SHA.
+
+    Brackets (algorithms.hyperband.bracket_plan) execute sequentially —
+    each is one ``fused_sha`` sweep, so within a bracket the whole
+    cohort trains/cuts on-device; between brackets there is one host
+    transition. Bracket seeds match the host-side ``Hyperband``
+    algorithm's (seed + 7919*b).
+
+    Returns the overall best plus a per-bracket summary.
+    """
+    from mpi_opt_tpu.algorithms.hyperband import bracket_plan
+
+    best = None
+    brackets = []
+    n_total = 0
+    for b, (n, r) in enumerate(bracket_plan(max_budget, eta)):
+        res = fused_sha(
+            workload,
+            n_trials=n,
+            min_budget=r,
+            max_budget=max_budget,
+            eta=eta,
+            seed=seed + 7919 * b,
+            member_chunk=member_chunk,
+            mesh=mesh,
+            round_to=round_to,
+        )
+        n_total += n
+        brackets.append(
+            {
+                "bracket": b,
+                "n_trials": n,
+                "start_budget": r,
+                "rung_sizes": res["rung_sizes"],
+                "rung_budgets": res["rung_budgets"],
+                "best_score": res["best_score"],
+            }
+        )
+        if best is None or res["best_score"] > best["best_score"]:
+            best = res
+    return {
+        "best_score": best["best_score"],
+        "best_params": best["best_params"],
+        "brackets": brackets,
+        "n_trials": n_total,
+    }

@@ -95,6 +95,8 @@ def fused_pbt(
     mesh=None,
     member_chunk: int = 0,
     gen_chunk: int = 0,
+    checkpoint_dir: str = None,
+    snapshot_every: int = 1,
 ):
     """Convenience wrapper: run a whole PBT sweep for a vision-style
     workload; optionally sharded over a ``('pop','data')`` mesh.
@@ -117,6 +119,19 @@ def fused_pbt(
     measured 2026-07-30: pop=128 x 4 gens x 100 steps survives, 8 gens
     does not), and because big-G scans compile slower for no runtime
     benefit: generations are identical program text.
+
+    ``checkpoint_dir`` makes the sweep crash-recoverable (SURVEY.md §5
+    failure model; this container's TPU worker demonstrably dies
+    mid-sweep): after every ``snapshot_every`` completed launches the
+    carried (state, unit, key) is fetched to host and orbax-saved with
+    the sweep config + curves. A fresh call with the same arguments and
+    directory resumes at the last snapshot and — because the RNG key is
+    part of the snapshot — finishes with the IDENTICAL result the
+    uninterrupted sweep would have produced (tested). A checkpoint
+    whose recorded config mismatches the call's raises ValueError.
+    Host-fetching before the async save (rather than saving device
+    buffers) is deliberate: the next launch donates the state buffers,
+    which would invalidate them under orbax's background write.
     """
     import numpy as np
 
@@ -154,31 +169,67 @@ def fused_pbt(
     base, rem = divmod(generations, n_launches)
     launch_lens = [base + 1] * rem + [base] * (n_launches - rem)
 
+    snap = None
+    start_launch = 0
     best_parts, mean_parts = [], []
-    for g in launch_lens:
-        # k_run is the scan-carried key returned by the previous launch:
-        # the chain continues exactly as one longer scan would have
-        state, unit, k_run, best, mean, final_scores = run_fused_pbt(
-            trainer,
-            state,
-            unit,
-            hparams_fn,
-            train_x=train_x,
-            train_y=train_y,
-            val_x=val_x,
-            val_y=val_y,
-            key=k_run,
-            discrete_mask=disc,
-            generations=g,
-            steps_per_gen=steps_per_gen,
-            cfg=cfg,
-        )
-        best_parts.append(best)
-        mean_parts.append(mean)
-    best = jnp.concatenate(best_parts)
-    mean = jnp.concatenate(mean_parts)
+    scores = None
+    if checkpoint_dir is not None:
+        import dataclasses
 
-    scores = np.asarray(final_scores)
+        sweep_config = {
+            "workload": getattr(workload, "name", type(workload).__name__),
+            "population": population,
+            "generations": generations,
+            "steps_per_gen": steps_per_gen,
+            "seed": seed,
+            "launch_lens": launch_lens,
+            "member_chunk": member_chunk,
+            # PBT knobs change exploit/explore behavior: resuming under a
+            # different cfg would not be the continuation we promise
+            "cfg": dataclasses.asdict(cfg),
+        }
+        snap = _SweepCheckpointer(checkpoint_dir, sweep_config, max(1, snapshot_every))
+        restored = snap.restore()
+        if restored is not None:
+            state, unit, k_run, scores, best_parts, mean_parts, start_launch = restored
+            if mesh is not None:
+                from mpi_opt_tpu.parallel.mesh import pop_sharding
+
+                state = shard_popstate(state, mesh)
+                unit = jax.device_put(unit, pop_sharding(mesh))
+
+    try:
+        for i in range(start_launch, n_launches):
+            # k_run is the scan-carried key returned by the previous
+            # launch: the chain continues exactly as one longer scan would
+            state, unit, k_run, best, mean, final_scores = run_fused_pbt(
+                trainer,
+                state,
+                unit,
+                hparams_fn,
+                train_x=train_x,
+                train_y=train_y,
+                val_x=val_x,
+                val_y=val_y,
+                key=k_run,
+                discrete_mask=disc,
+                generations=launch_lens[i],
+                steps_per_gen=steps_per_gen,
+                cfg=cfg,
+            )
+            # curves to host eagerly: they are tiny, and a later crash
+            # must not lose completed launches' history
+            best_parts.append(np.asarray(best))
+            mean_parts.append(np.asarray(mean))
+            scores = np.asarray(final_scores)
+            if snap is not None:
+                snap.maybe_save(i + 1, n_launches, state, unit, k_run, scores,
+                                best_parts, mean_parts)
+    finally:
+        if snap is not None:
+            snap.close()
+    best = np.concatenate(best_parts)
+    mean = np.concatenate(mean_parts)
     best_i = int(scores.argmax())
     return {
         "best_score": float(scores[best_i]),
@@ -208,3 +259,103 @@ class _HParamsFn:
         return isinstance(other, _HParamsFn) and (
             self.space is other.space and self.workload is other.workload
         )
+
+
+class _SweepCheckpointer:
+    """Durable launch-granular snapshots of a fused sweep.
+
+    Items per orbax step (= completed launch count):
+    - ``sweep`` (StandardSave): host copies of the carried population
+      state, unit hparams, RNG key data, and the last generation's
+      scores. Host-fetched BEFORE saving because the next launch
+      donates the device buffers out from under an async writer.
+    - ``meta`` (JsonSave): the sweep config (validated on restore — a
+      checkpoint from a different sweep shape must not silently load)
+      plus completed-launch curves.
+    """
+
+    def __init__(self, directory: str, config: dict, every: int):
+        import os
+
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.config = config
+        self.every = every
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(max_to_keep=2, create=True),
+        )
+
+    def maybe_save(self, launches_done, n_launches, state, unit, key, scores,
+                   best_parts, mean_parts):
+        import numpy as np
+
+        last = launches_done == n_launches
+        if launches_done % self.every and not last:
+            return
+        host = jax.device_get(
+            {"params": state.params, "momentum": state.momentum, "step": state.step}
+        )
+        sweep = {
+            "state": host,
+            "unit": np.asarray(unit),
+            "key_data": np.asarray(jax.random.key_data(key)),
+            "scores": np.asarray(scores),
+        }
+        meta = {
+            "config": self.config,
+            "launches_done": launches_done,
+            "best": [v.tolist() for v in best_parts],
+            "mean": [v.tolist() for v in mean_parts],
+        }
+        self._mgr.save(
+            launches_done,
+            args=self._ocp.args.Composite(
+                sweep=self._ocp.args.StandardSave(sweep),
+                meta=self._ocp.args.JsonSave(meta),
+            ),
+        )
+
+    def restore(self):
+        """(state, unit, key, scores, best_parts, mean_parts, launches_done)
+        from the latest snapshot, or None if the directory is empty.
+        Raises ValueError on a config mismatch."""
+        import numpy as np
+
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        r = self._mgr.restore(
+            step,
+            args=self._ocp.args.Composite(
+                sweep=self._ocp.args.StandardRestore(),
+                meta=self._ocp.args.JsonRestore(),
+            ),
+        )
+        if r.meta["config"] != self.config:
+            raise ValueError(
+                "checkpoint directory holds a different sweep: "
+                f"saved config {r.meta['config']} vs requested {self.config}"
+            )
+        state = PopState(
+            params=r.sweep["state"]["params"],
+            momentum=r.sweep["state"]["momentum"],
+            step=r.sweep["state"]["step"],
+        )
+        key = jax.random.wrap_key_data(jnp.asarray(r.sweep["key_data"]))
+        best_parts = [np.asarray(v, dtype=np.float32) for v in r.meta["best"]]
+        mean_parts = [np.asarray(v, dtype=np.float32) for v in r.meta["mean"]]
+        return (
+            state,
+            r.sweep["unit"],
+            key,
+            np.asarray(r.sweep["scores"]),
+            best_parts,
+            mean_parts,
+            int(r.meta["launches_done"]),
+        )
+
+    def close(self):
+        self._mgr.wait_until_finished()
+        self._mgr.close()

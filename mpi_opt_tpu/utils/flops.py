@@ -123,7 +123,9 @@ def population_sweep_flops(
             type(trainer).eval_population, trainer, state, vx, vy, eval_chunk=eval_chunk
         )
         if f_step is None or f_eval is None:
-            return None
+            raise RuntimeError(
+                f"cost analysis returned no flops (step={f_step}, eval={f_eval})"
+            )
         n_val = int(jnp.shape(jnp.asarray(d["val_y"]))[0])
         n_chunks = -(-n_val // eval_chunk)
         if n_evals is None:
@@ -131,7 +133,15 @@ def population_sweep_flops(
         return population * (
             generations * steps_per_gen * f_step + n_evals * n_chunks * f_eval
         )
-    except Exception:
+    except Exception as e:
+        # None (not a crash) keeps benches running without flops, but a
+        # silent None turns MFU into a mystery — say why on stderr
+        import sys
+
+        print(
+            f"[flops] population_sweep_flops unavailable: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
         return None
 
 

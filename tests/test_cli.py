@@ -52,3 +52,58 @@ def test_cli_quadratic_pbt(capsys):
     assert rc == 0
     summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert summary["n_trials"] == 24
+
+
+def test_fused_pbt_cli(capsys, tmp_path):
+    rc = main(
+        [
+            "--workload", "fashion_mlp",
+            "--algorithm", "pbt",
+            "--fused",
+            "--population", "8",
+            "--generations", "2",
+            "--steps-per-generation", "5",
+            "--seed", "0",
+            "--checkpoint-dir", str(tmp_path / "ck"),
+        ]
+    )
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.strip().splitlines() if l.startswith("{")]
+    summary = json.loads(lines[-1])
+    assert summary["backend"] == "fused"
+    assert summary["n_trials"] == 16
+    assert len(summary["best_curve"]) == 2
+    assert 0.0 <= summary["best_score"] <= 1.0
+    assert "lr" in summary["best_params"]
+
+
+def test_fused_asha_cli(capsys):
+    rc = main(
+        [
+            "--workload", "fashion_mlp",
+            "--algorithm", "asha",
+            "--fused",
+            "--trials", "9",
+            "--min-budget", "5",
+            "--max-budget", "45",
+            "--eta", "3",
+            "--seed", "0",
+        ]
+    )
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.strip().splitlines() if l.startswith("{")]
+    summary = json.loads(lines[-1])
+    assert summary["backend"] == "fused"
+    assert summary["n_trials"] == 9
+    assert summary["rung_sizes"][0] == 9
+    assert 0.0 <= summary["best_score"] <= 1.0
+
+
+def test_fused_rejects_non_population_workload():
+    with pytest.raises(SystemExit):
+        main(["--workload", "digits", "--algorithm", "pbt", "--fused"])
+
+
+def test_fused_rejects_random_algorithm():
+    with pytest.raises(SystemExit):
+        main(["--workload", "fashion_mlp", "--algorithm", "random", "--fused"])

@@ -1,0 +1,70 @@
+"""Crash-recovery of fused PBT sweeps (SURVEY.md §5 failure model).
+
+The platform this framework targets demonstrably kills TPU workers
+mid-sweep (PERF_NOTES.md); these tests prove a killed sweep resumes
+from its launch-granular orbax snapshots to the BIT-IDENTICAL result
+of an uninterrupted run — the RNG key is part of the snapshot, so the
+continued trajectory is exactly the one the crash interrupted.
+"""
+
+import numpy as np
+import pytest
+
+import mpi_opt_tpu.train.fused_pbt as fp
+from mpi_opt_tpu.workloads import get_workload
+
+
+def _wl():
+    return get_workload("fashion_mlp", n_train=256, n_val=128)
+
+
+KW = dict(population=8, generations=4, steps_per_gen=5, seed=2, gen_chunk=1)
+
+
+def test_crash_resume_bit_identical(tmp_path, monkeypatch):
+    wl = _wl()
+    whole = fp.fused_pbt(wl, **KW)
+
+    real = fp.run_fused_pbt
+    calls = {"n": 0}
+
+    def crashing(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 3:  # die mid-sweep, after 2 completed launches
+            raise RuntimeError("simulated TPU worker crash")
+        return real(*a, **k)
+
+    ckpt = str(tmp_path / "ck")
+    monkeypatch.setattr(fp, "run_fused_pbt", crashing)
+    with pytest.raises(RuntimeError, match="simulated"):
+        fp.fused_pbt(wl, checkpoint_dir=ckpt, **KW)
+    monkeypatch.setattr(fp, "run_fused_pbt", real)
+
+    resumed = fp.fused_pbt(wl, checkpoint_dir=ckpt, **KW)
+    np.testing.assert_array_equal(resumed["best_curve"], whole["best_curve"])
+    np.testing.assert_array_equal(resumed["mean_curve"], whole["mean_curve"])
+    np.testing.assert_array_equal(resumed["unit"], whole["unit"])
+    assert resumed["best_score"] == whole["best_score"]
+
+
+def test_resume_after_completion_skips_all_launches(tmp_path, monkeypatch):
+    wl = _wl()
+    ckpt = str(tmp_path / "ck")
+    first = fp.fused_pbt(wl, checkpoint_dir=ckpt, **KW)
+
+    def boom(*a, **k):  # a re-run must not execute anything
+        raise AssertionError("completed sweep re-ran a launch")
+
+    monkeypatch.setattr(fp, "run_fused_pbt", boom)
+    again = fp.fused_pbt(wl, checkpoint_dir=ckpt, **KW)
+    np.testing.assert_array_equal(again["best_curve"], first["best_curve"])
+    assert again["best_score"] == first["best_score"]
+
+
+def test_checkpoint_config_mismatch_raises(tmp_path):
+    wl = _wl()
+    ckpt = str(tmp_path / "ck")
+    fp.fused_pbt(wl, checkpoint_dir=ckpt, **KW)
+    other = dict(KW, seed=KW["seed"] + 1)
+    with pytest.raises(ValueError, match="different sweep"):
+        fp.fused_pbt(wl, checkpoint_dir=ckpt, **other)
