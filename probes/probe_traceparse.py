@@ -1,0 +1,21 @@
+"""Parse a jax.profiler chrome trace (vm.trace.json.gz) into a leaf-op
+time breakdown. Usage: python - < probes/probe_traceparse.py  (edit PATH)."""
+import gzip, json, collections, sys, glob
+
+path = sorted(glob.glob("/tmp/prof_r2/plugins/profile/*/vm.trace.json.gz"))[-1]
+with gzip.open(path) as f:
+    tr = json.load(f)
+events = tr.get("traceEvents", [])
+pids = {e["pid"]: e["args"].get("name", "") for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"}
+agg, cnt = collections.Counter(), collections.Counter()
+for e in events:
+    if e.get("ph") != "X" or "dur" not in e: continue
+    if "TPU" not in str(pids.get(e["pid"], "")): continue
+    n = e.get("name", "?")
+    if n.startswith(("jit_", "while", "body", "condition")): continue
+    agg[n] += e["dur"]; cnt[n] += 1
+tot = sum(agg.values())
+print(f"device leaf total: {tot/1e6:.3f}s ({path})")
+for n, d in agg.most_common(30):
+    print(f"{d/1e6:8.3f}s  x{cnt[n]:5}  {n}")
