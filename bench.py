@@ -18,23 +18,26 @@ Both sides do identical work on identical shapes.
   The default population is 256 — the north-star sweep size
   (BASELINE.json: "256-member PBT CIFAR-10 CNN sweep").
 
-- Baseline: the CPU process-pool backend evaluating the same member-
-  generations — one process per trial, the same execution model as the
-  reference's per-rank MPI workers (no MPI exists in this container;
-  see BASELINE.md — the reference itself has no published numbers).
-  The pool is warmed first so worker spawn/import/compile time is
-  excluded.
+- Baseline: a torch-CPU member-generation — the reference's actual
+  per-rank stack (torch/keras on CPU over MPI), same layer shapes,
+  batch, and eval size, single-threaded like one MPI rank. Measured
+  directly (~80 s/member-gen on this box, fast enough to measure
+  live). This is deliberately the STRONGEST honest baseline available:
+  our own CPU backend (XLA:CPU) executes conv training at ~0.7 GFLOP/s
+  on this host vs torch's ~46 GFLOP/s — a pathology of XLA:CPU codegen
+  here, not a property of the reference — so using it as the
+  denominator would inflate the speedup ~65x. The jax-pool protocol
+  remains available via --baseline-pool (cached in CPU_BASELINE.json;
+  takes ~40 min first-ever). Full story: PERF_NOTES.md.
 
 Baseline normalizations (both reported; the headline ``vs_baseline`` is
-the HONEST one):
+the 8-rank one):
 - ``vs_baseline`` / ``vs_8rank_equiv``: TPU throughput vs an 8-rank
-  pool extrapolated LINEARLY from the measured per-process rate
-  (8 x per-proc trials/sec). This box has os.cpu_count()=1, so a real
-  8-worker pool would timeshare one core; linear extrapolation is the
-  generous-to-the-baseline stand-in for the north star's "8-rank MPI",
-  assuming perfect scaling and zero MPI overhead.
-- ``vs_measured_pool``: TPU throughput vs the pool as actually measured
-  on this box (the round-1 number's definition).
+  pool at 8x the measured single-rank rate. This box has
+  os.cpu_count()=1, so a real 8-rank pool would timeshare one core;
+  linear scaling is the generous-to-the-baseline stand-in for the
+  north star's "8-rank MPI" (zero MPI overhead charged).
+- ``vs_one_rank``: TPU throughput vs the single measured rank.
 
 MFU: sweep FLOPs (composed from single-trip XLA cost-analysis pieces —
 see utils/flops.py for why whole-program counts can't be trusted)
@@ -165,13 +168,125 @@ def measure_platform_cap(iters=8):
     return 8 * 2 * M**3 / dt / 1e12
 
 
-def bench_cpu_baseline(steps, seed, n_workers):
-    """Reference-architecture stand-in: process-per-trial evaluation."""
+def bench_cpu_baseline_torch(steps, seed, measure_steps=20):
+    """Reference-fidelity baseline: one MPI rank's member-generation in
+    torch on CPU (the reference stack), single-threaded.
+
+    Same work as one TPU-side member-generation: ``steps`` SGD+momentum
+    steps on a SmallCNN of identical layer shapes at batch 256, plus a
+    full 2048-image validation eval. Per-step cost is steady-state
+    constant on CPU, so we measure ``measure_steps`` and scale — stated
+    in the provenance. Augmentation is omitted on this side (the TPU
+    side pays for it), which favors the baseline, i.e. is conservative
+    for the reported speedup.
+
+    Returns (trials_per_sec, provenance_str).
+    """
+    import torch
+    import torch.nn.functional as tF
+    from torch import nn
+
+    torch.manual_seed(seed)
+    torch.set_num_threads(1)  # one rank = one core, like the MPI reference
+
+    w, n_classes, batch, n_val = 32, 10, 256, 2048
+
+    class TorchSmallCNN(nn.Module):
+        # mirrors models/cnn.py SmallCNN: conv32-conv32-pool-conv64-
+        # conv64-pool-fc128-fc10, GroupNorm(8)
+        def __init__(self):
+            super().__init__()
+            chans = [3, w, w, 2 * w, 2 * w]
+            self.blocks = nn.ModuleList(
+                nn.ModuleList([
+                    nn.Conv2d(chans[i], chans[i + 1], 3, padding=1),
+                    nn.GroupNorm(8, chans[i + 1]),
+                ])
+                for i in range(4)
+            )
+            self.fc1 = nn.Linear(2 * w * 8 * 8, 4 * w)
+            self.fc2 = nn.Linear(4 * w, n_classes)
+
+        def forward(self, x):
+            for i, (conv, gn) in enumerate(self.blocks):
+                x = tF.relu(gn(conv(x)))
+                if i % 2 == 1:
+                    x = tF.max_pool2d(x, 2)
+            x = x.flatten(1)
+            return self.fc2(tF.relu(self.fc1(x)))
+
+    model = TorchSmallCNN()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9, weight_decay=1e-4)
+    g = torch.Generator().manual_seed(seed)
+    x = torch.randn(batch, 3, 32, 32, generator=g)
+    y = torch.randint(0, n_classes, (batch,), generator=g)
+
+    def step():
+        opt.zero_grad()
+        tF.cross_entropy(model(x), y).backward()
+        opt.step()
+
+    step(); step()  # warm (allocator, oneDNN primitive caches)
+    t0 = time.perf_counter()
+    for _ in range(measure_steps):
+        step()
+    per_step = (time.perf_counter() - t0) / measure_steps
+
+    model.eval()
+    vx = torch.randn(n_val, 3, 32, 32, generator=g)
+    with torch.no_grad():
+        model(vx[:batch])  # warm
+        t0 = time.perf_counter()
+        for i in range(0, n_val, batch):
+            model(vx[i : i + batch])
+        eval_s = time.perf_counter() - t0
+
+    member_gen_s = steps * per_step + eval_s
+    tps = 1.0 / member_gen_s
+    provenance = (
+        f"torch-CPU single-thread (reference per-rank stack), same layer "
+        f"shapes/batch/eval: {per_step:.2f}s/step x {steps} + {eval_s:.1f}s "
+        f"eval = {member_gen_s:.1f}s/member-gen (per-step measured over "
+        f"{measure_steps} steady-state steps)"
+    )
+    log(f"[bench] cpu baseline (torch): {provenance} -> {tps:.5f} trials/s/rank")
+    return tps, provenance
+
+
+def bench_cpu_baseline(steps, seed, n_workers, cache_path="CPU_BASELINE.json",
+                       b_small=2, b_large=12):
+    """Reference-architecture stand-in: process-per-trial evaluation,
+    genuinely on CPU (the pool worker pins the platform).
+
+    A real 100-step member-generation takes this box's single core tens
+    of minutes (round 1's '5.79s' baseline was secretly running on the
+    TPU through the then-unpinned inline path — fixed since, and the
+    honest number is ~400x slower). Measuring cost(steps) directly is
+    therefore infeasible inside a bench run; instead we measure
+    cost(b_small) and cost(b_large) warm (the per-step cost on one core
+    is strictly linear — no batching/caching effects across steps) and
+    extrapolate: cost(S) = cost(b_small) + slope * (S - b_small), where
+    the intercept carries the fixed per-trial work (final eval +
+    dispatch). The result, with its full provenance, is cached in
+    ``cache_path`` so repeat bench runs (e.g. the driver's) don't repay
+    a multi-minute measurement; delete the file to re-measure.
+    """
+    import json as _json
+    import os as _os
+
     import jax
 
     from mpi_opt_tpu.backends.cpu import CPUBackend
     from mpi_opt_tpu.trial import Trial
     from mpi_opt_tpu.workloads import get_workload
+
+    if _os.path.exists(cache_path):
+        with open(cache_path) as f:
+            rec = _json.load(f)
+        if rec.get("steps") == steps and rec.get("n_workers") == n_workers:
+            log(f"[bench] cpu baseline from {cache_path}: "
+                f"{rec['pool_trials_per_sec']:.6f} trials/s ({rec['provenance']})")
+            return rec["pool_trials_per_sec"]
 
     wl = get_workload("cifar10_cnn")
     space = wl.default_space()
@@ -192,20 +307,51 @@ def bench_cpu_baseline(steps, seed, n_workers):
             )
         return out
 
-    log(f"[bench] cpu baseline: warming {n_workers}-process pool")
+    def timed_eval(base_id, budget):
+        """Wall for one batch of n_workers PARALLEL trials (pool.map):
+        with perfect scaling this equals one trial's cost, and the pool
+        completes n_workers trials per such wall."""
+        t0 = time.perf_counter()
+        be.evaluate(make_trials(base_id, budget))
+        return time.perf_counter() - t0
+
+    log(f"[bench] cpu baseline: warming {n_workers}-process pool "
+        f"(compiles budget={b_small}/{b_large} programs; slow first-ever)")
     t0 = time.perf_counter()
-    # warm with the SAME budget: train_segment's scan length is a static
-    # jit arg, so a budget=1 warmup would leave the full compile inside
-    # the measured window and understate the baseline
-    be.evaluate(make_trials(0, steps))
+    timed_eval(0, b_small)  # compile+run small program
+    timed_eval(100, b_large)  # compile+run large program
     log(f"[bench] pool warm in {time.perf_counter()-t0:.1f}s")
-    t0 = time.perf_counter()
-    be.evaluate(make_trials(1000, steps))
-    wall = time.perf_counter() - t0
+    c_small = timed_eval(200, b_small)
+    c_large = timed_eval(300, b_large)
     be.close()
-    pool_tps = n_workers / wall
-    log(f"[bench] cpu: {n_workers} member-gens in {wall:.2f}s -> "
-        f"{pool_tps:.4f} trials/s ({n_workers} procs)")
+    slope = max((c_large - c_small) / (b_large - b_small), 0.0)
+    c_steps = c_small + slope * (steps - b_small)
+    # the pool finishes n_workers parallel trials per c_steps of wall
+    pool_tps = n_workers / c_steps
+    provenance = (
+        f"linear extrapolation: batch-wall({b_small})={c_small:.1f}s, "
+        f"batch-wall({b_large})={c_large:.1f}s -> {slope:.2f}s/step, "
+        f"batch-wall({steps})={c_steps:.1f}s for {n_workers} parallel "
+        f"trials, measured on a platform-pinned CPU pool"
+    )
+    log(f"[bench] cpu: {provenance} -> {pool_tps:.6f} trials/s ({n_workers} procs)")
+    rec = {
+        "steps": steps,
+        "n_workers": n_workers,
+        "b_small": b_small,
+        "b_large": b_large,
+        "cost_small_s": round(c_small, 2),
+        "cost_large_s": round(c_large, 2),
+        "slope_s_per_step": round(slope, 3),
+        "cost_steps_s": round(c_steps, 2),
+        "pool_trials_per_sec": pool_tps,
+        "provenance": provenance,
+    }
+    try:
+        with open(cache_path, "w") as f:
+            _json.dump(rec, f, indent=1)
+    except OSError as e:
+        log(f"[bench] could not cache baseline: {e}")
     return pool_tps
 
 
@@ -225,6 +371,13 @@ def main():
     p.add_argument("--target-acc", type=float, default=0.70)
     p.add_argument("--workers", type=int, default=min(8, os.cpu_count() or 8))
     p.add_argument("--skip-baseline", action="store_true")
+    p.add_argument(
+        "--baseline-pool",
+        action="store_true",
+        help="use the jax-CPU process pool as the baseline instead of the "
+        "torch reference stack (slow + understates the reference on this "
+        "host; see PERF_NOTES.md)",
+    )
     p.add_argument("--profile-dir", default=None)
     args = p.parse_args()
 
@@ -254,21 +407,25 @@ def main():
         record["vs_baseline"] = 1.0
         record["baseline"] = "skipped"
     else:
-        pool_tps = bench_cpu_baseline(args.steps, args.seed, args.workers)
-        per_proc = pool_tps / args.workers
-        rank8 = 8.0 * per_proc
-        record["cpu_pool_workers"] = args.workers
-        record["cpu_pool_trials_per_sec"] = round(pool_tps, 4)
-        record["vs_measured_pool"] = round(tpu["tps"] / pool_tps, 2)
+        if args.baseline_pool:
+            pool_tps = bench_cpu_baseline(args.steps, args.seed, args.workers)
+            per_rank = pool_tps / args.workers
+            prov = (
+                f"jax-CPU {args.workers}-proc pool (XLA:CPU runs convs at "
+                f"~0.7 GFLOP/s on this host — understates the reference ~65x; "
+                f"PERF_NOTES.md)"
+            )
+        else:
+            per_rank, prov = bench_cpu_baseline_torch(args.steps, args.seed)
+        rank8 = 8.0 * per_rank
+        record["cpu_rank_trials_per_sec"] = round(per_rank, 5)
+        record["vs_one_rank"] = round(tpu["tps"] / per_rank, 2)
         record["vs_8rank_equiv"] = round(tpu["tps"] / rank8, 2)
-        # the headline number is the HONEST normalization: vs an 8-rank
-        # pool extrapolated linearly from the measured per-process rate
+        # the headline number is the HONEST normalization: one chip vs an
+        # 8-rank pool at the measured single-rank rate (linear scaling
+        # assumed for the baseline — generous to it: zero MPI overhead)
         record["vs_baseline"] = record["vs_8rank_equiv"]
-        record["baseline"] = (
-            f"8-rank equivalent = 8 x measured per-process CPU rate "
-            f"({per_proc:.4f} trials/s/proc, {args.workers}-proc pool, "
-            f"cpu_count={os.cpu_count()})"
-        )
+        record["baseline"] = f"8-rank equivalent = 8 x single-rank rate; rank = {prov}"
     print(json.dumps(record))
 
 

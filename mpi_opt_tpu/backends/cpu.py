@@ -57,6 +57,15 @@ def _init_pool_worker(workload_name: str, workload_kwargs: dict):
         # this child), continuing would let N workers race the real TPU
         # and hang — fail loudly instead.
         jax.config.update("jax_platforms", "cpu")
+        # Persistent compile cache: XLA:CPU takes minutes-to-tens-of-
+        # minutes to compile conv training programs (measured: >12 min
+        # for the 100-step SmallCNN segment on this container), and a
+        # fresh pool otherwise pays that on every process start. The
+        # dir is platform-specific on purpose — mixing CPU and TPU
+        # artifacts in one cache trips machine-feature mismatches.
+        cache = os.environ.get("MPI_OPT_TPU_CPU_CACHE_DIR", "/tmp/jax_cache_cpu")
+        if cache:  # set env var to "" to disable
+            jax.config.update("jax_compilation_cache_dir", cache)
     _init_worker(workload_name, workload_kwargs)
 
 

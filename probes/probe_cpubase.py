@@ -1,0 +1,7 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+sys.argv = ["bench.py"]
+import bench
+t0 = time.perf_counter()
+tps = bench.bench_cpu_baseline(steps=100, seed=0, n_workers=1)
+print(f"POOL_TPS {tps} total_wall {time.perf_counter()-t0:.1f}s")

@@ -1,0 +1,59 @@
+"""Reference-fidelity baseline arbitration: the same member-generation
+work (SmallCNN-equivalent, batch 256, CIFAR shapes) in torch on CPU.
+If torch is much faster than our jax-CPU worker, the jax-CPU baseline
+understates the reference and must not be used as the denominator."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+torch.manual_seed(0)
+torch.set_num_threads(1)  # one rank = one core, like the MPI reference
+
+class SmallCNN(nn.Module):
+    def __init__(self, w=32, n_classes=10):
+        super().__init__()
+        self.c = nn.ModuleList()
+        chans = [3, w, w, 2*w, 2*w]
+        for i in range(4):
+            self.c.append(nn.Conv2d(chans[i], chans[i+1], 3, padding=1))
+            self.c.append(nn.GroupNorm(8, chans[i+1]))
+        self.fc1 = nn.Linear(2*w*8*8, 4*w)
+        self.fc2 = nn.Linear(4*w, n_classes)
+    def forward(self, x):
+        for i in range(4):
+            x = F.relu(self.c[2*i+1](self.c[2*i](x)))
+            if i % 2 == 1:
+                x = F.max_pool2d(x, 2)
+        x = x.flatten(1)
+        return self.fc2(F.relu(self.fc1(x)))
+
+model = SmallCNN()
+opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9, weight_decay=1e-4)
+x = torch.randn(256, 3, 32, 32)
+y = torch.randint(0, 10, (256,))
+
+# warmup
+for _ in range(2):
+    opt.zero_grad(); F.cross_entropy(model(x), y).backward(); opt.step()
+t0 = time.perf_counter()
+n = 10
+for _ in range(n):
+    opt.zero_grad(); F.cross_entropy(model(x), y).backward(); opt.step()
+dt = (time.perf_counter() - t0) / n
+print(f"torch cpu train step (batch 256): {dt:.2f}s -> {36.6/dt:.1f} GFLOP/s", flush=True)
+
+# eval 2048
+model.eval()
+vx = torch.randn(2048, 3, 32, 32)
+with torch.no_grad():
+    model(vx[:256])  # warm
+    t0 = time.perf_counter()
+    for i in range(0, 2048, 256):
+        model(vx[i:i+256])
+    ev = time.perf_counter() - t0
+print(f"torch cpu eval 2048: {ev:.2f}s", flush=True)
+print(f"torch cpu member-gen (100 steps + eval): {100*dt + ev:.1f}s "
+      f"({1/(100*dt+ev):.5f} trials/s)", flush=True)

@@ -1,0 +1,14 @@
+import sys, time, os
+sys.path.insert(0, "/root/repo")
+os.environ["MPI_OPT_TPU_CPU_CACHE_DIR"] = "/tmp/jax_cache_cpu_native"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cpu_native")
+from mpi_opt_tpu.workloads import get_workload
+
+wl = get_workload("cifar10_cnn")
+p = {"lr": 0.1, "momentum": 0.9, "weight_decay": 1e-4, "flip_prob": 0.2, "shift": 2.0}
+for budget in (5, 5, 25):  # first 5 includes compile; second is pure exec
+    t0 = time.perf_counter()
+    s = wl.evaluate(p, budget=budget, seed=0)
+    print(f"evaluate(budget={budget}): {time.perf_counter()-t0:.1f}s score={s:.3f}", flush=True)
