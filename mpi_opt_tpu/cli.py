@@ -76,9 +76,9 @@ def build_parser() -> argparse.ArgumentParser:
         "--fused",
         action="store_true",
         help="run the whole sweep on-device (pbt/asha/hyperband): no "
-        "driver round-trips, population never leaves the device; for "
-        "pbt, --checkpoint-dir makes it crash-recoverable at launch "
-        "granularity",
+        "driver round-trips, population never leaves the device; "
+        "--checkpoint-dir makes it crash-recoverable (pbt: launch "
+        "granularity, asha/hyperband: rung granularity)",
     )
     p.add_argument(
         "--member-chunk",
@@ -142,12 +142,6 @@ def run_fused(args, parser, workload) -> int:
 
     if not isinstance(workload, PopulationWorkload):
         parser.error(f"--fused requires a population workload, not {args.workload!r}")
-    if args.checkpoint_dir and args.algorithm != "pbt":
-        # a silent no-op here would betray the crash-recovery promise
-        parser.error(
-            "--checkpoint-dir with --fused is only supported for pbt "
-            "(fused asha/hyperband sweeps have no snapshot support yet)"
-        )
     import jax
 
     n_chips = jax.local_device_count()
@@ -182,6 +176,7 @@ def run_fused(args, parser, workload) -> int:
                 eta=args.eta,
                 seed=args.seed,
                 member_chunk=args.member_chunk,
+                checkpoint_dir=args.checkpoint_dir,
             )
             n_trials = res["n_trials"]
             extra = {"rung_sizes": res["rung_sizes"], "rung_budgets": res["rung_budgets"]}
@@ -194,6 +189,7 @@ def run_fused(args, parser, workload) -> int:
                 eta=args.eta,
                 seed=args.seed,
                 member_chunk=args.member_chunk,
+                checkpoint_dir=args.checkpoint_dir,
             )
             n_trials = res["n_trials"]
             extra = {"brackets": res["brackets"]}

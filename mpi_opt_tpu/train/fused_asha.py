@@ -29,6 +29,7 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from mpi_opt_tpu.ops.asha import asha_cut, asha_rungs
@@ -72,11 +73,20 @@ def fused_sha(
     member_chunk: int = 0,
     mesh=None,
     round_to: int = 1,
+    checkpoint_dir: str = None,
 ):
     """Run a whole successive-halving sweep with on-device rung cuts.
 
     Returns a dict with the best trial's score/params, per-rung sizes
     and budgets, and a per-trial ledger (stop rung + last score).
+
+    ``checkpoint_dir`` makes the sweep crash-recoverable at RUNG
+    granularity (same failure model as fused_pbt's launch snapshots):
+    after each rung's cut the surviving cohort (state, unit, RNG key)
+    and the trial ledger are orbax-saved; a fresh call with the same
+    arguments resumes at the next rung and — the key being part of the
+    snapshot — produces the IDENTICAL result of an uninterrupted run.
+    A config-mismatched checkpoint raises ValueError.
     """
     from mpi_opt_tpu.parallel.mesh import pop_sharding, replicate, shard_popstate
 
@@ -90,8 +100,43 @@ def fused_sha(
 
     key = jax.random.key(seed)
     k_init, k_unit, k_run = jax.random.split(key, 3)
-    unit = space.sample_unit(k_unit, n_trials)
-    state = trainer.init_population(k_init, train_x[:2], n_trials)
+
+    # host ledger: which original trial occupies each population row
+    alive = np.arange(n_trials)
+    stop_rung = np.zeros(n_trials, dtype=np.int32)
+    last_score = np.full(n_trials, np.nan, dtype=np.float32)
+
+    # restore BEFORE initializing: a resumed sweep must not pay (or
+    # transiently hold the memory of) a full-cohort init it discards
+    snap = None
+    restored = None
+    start_rung = 0
+    scores = None
+    if checkpoint_dir is not None:
+        from mpi_opt_tpu.utils.checkpoint import SweepCheckpointer
+
+        snap = SweepCheckpointer(
+            checkpoint_dir,
+            {
+                "workload": getattr(workload, "name", type(workload).__name__),
+                "n_trials": n_trials,
+                "rungs": rungs,
+                "sizes": sizes,
+                "eta": eta,
+                "seed": seed,
+                "member_chunk": member_chunk,
+            },
+        )
+        restored = snap.restore_population_sweep()
+        if restored is not None:
+            state, unit, k_run, scores, meta = restored
+            alive = np.asarray(meta["alive"], dtype=np.int64)
+            stop_rung = np.asarray(meta["stop_rung"], dtype=np.int32)
+            last_score = np.asarray(meta["last_score"], dtype=np.float32)
+            start_rung = int(meta["rungs_done"])
+    if restored is None:
+        unit = space.sample_unit(k_unit, n_trials)
+        state = trainer.init_population(k_init, train_x[:2], n_trials)
     if mesh is not None:
         state = shard_popstate(state, mesh)
         unit = jax.device_put(unit, pop_sharding(mesh))
@@ -99,34 +144,44 @@ def fused_sha(
         train_x, train_y = jax.device_put(train_x, rep), jax.device_put(train_y, rep)
         val_x, val_y = jax.device_put(val_x, rep), jax.device_put(val_y, rep)
 
-    # host ledger: which original trial occupies each population row
-    alive = np.arange(n_trials)
-    stop_rung = np.zeros(n_trials, dtype=np.int32)
-    last_score = np.full(n_trials, np.nan, dtype=np.float32)
-
-    prev_budget = 0
-    scores = None
-    for r, budget in enumerate(rungs):
-        k_run, k_seg = jax.random.split(k_run)
-        hp = workload.make_hparams(space.from_unit(unit))
-        state, _ = trainer.train_segment(
-            state, hp, train_x, train_y, k_seg, budget - prev_budget
-        )
-        scores = trainer.eval_population(state, val_x, val_y)
-        np_scores = np.asarray(scores)
-        stop_rung[alive] = r
-        last_score[alive] = np_scores
-        prev_budget = budget
-        if r == len(rungs) - 1:
-            break
-        state, unit, keep, _ = _cut_and_gather(
-            trainer, state, unit, scores, eta, sizes[r + 1]
-        )
-        if mesh is not None:
-            # re-place: the gather may leave survivors unsharded/skewed
-            state = shard_popstate(state, mesh)
-            unit = jax.device_put(unit, pop_sharding(mesh))
-        alive = alive[np.asarray(keep)]
+    try:
+        for r in range(start_rung, len(rungs)):
+            budget = rungs[r]
+            prev_budget = rungs[r - 1] if r > 0 else 0
+            k_run, k_seg = jax.random.split(k_run)
+            hp = workload.make_hparams(space.from_unit(unit))
+            state, _ = trainer.train_segment(
+                state, hp, train_x, train_y, k_seg, budget - prev_budget
+            )
+            scores = trainer.eval_population(state, val_x, val_y)
+            np_scores = np.asarray(scores)
+            stop_rung[alive] = r
+            last_score[alive] = np_scores
+            if r < len(rungs) - 1:
+                state, unit, keep, _ = _cut_and_gather(
+                    trainer, state, unit, scores, eta, sizes[r + 1]
+                )
+                if mesh is not None:
+                    # re-place: the gather may leave survivors unsharded/skewed
+                    state = shard_popstate(state, mesh)
+                    unit = jax.device_put(unit, pop_sharding(mesh))
+                alive = alive[np.asarray(keep)]
+                # post-cut survivors' scores, for a resume-at-complete result
+                np_scores = np.asarray(scores)[np.asarray(keep)]
+            if snap is not None:
+                # scores saved = the CURRENT cohort rows (post-cut when cut)
+                snap.save_population_sweep(
+                    r + 1, state, unit, k_run, np_scores,
+                    meta_extra={
+                        "rungs_done": r + 1,
+                        "alive": alive.tolist(),
+                        "stop_rung": stop_rung.tolist(),
+                        "last_score": [float(v) for v in last_score],
+                    },
+                )
+    finally:
+        if snap is not None:
+            snap.close()
 
     np_unit = np.asarray(unit)
     best_row = int(np.asarray(scores).argmax())
@@ -150,6 +205,7 @@ def fused_hyperband(
     member_chunk: int = 0,
     mesh=None,
     round_to: int = 1,
+    checkpoint_dir: str = None,
 ):
     """Hyperband with every bracket running as a fused on-device SHA.
 
@@ -160,7 +216,14 @@ def fused_hyperband(
     algorithm's (seed + 7919*b).
 
     Returns the overall best plus a per-bracket summary.
+
+    ``checkpoint_dir`` gives each bracket its own rung-checkpointed
+    subdirectory (``bracket_0``, ...): a crash resumes inside the
+    interrupted bracket, and brackets already complete replay instantly
+    from their final snapshot.
     """
+    import os
+
     from mpi_opt_tpu.algorithms.hyperband import bracket_plan
 
     best = None
@@ -177,6 +240,9 @@ def fused_hyperband(
             member_chunk=member_chunk,
             mesh=mesh,
             round_to=round_to,
+            checkpoint_dir=(
+                os.path.join(checkpoint_dir, f"bracket_{b}") if checkpoint_dir else None
+            ),
         )
         n_total += n
         brackets.append(

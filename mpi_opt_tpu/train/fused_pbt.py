@@ -145,8 +145,52 @@ def fused_pbt(
     )
     key = jax.random.key(seed)
     k_init, k_unit, k_run = jax.random.split(key, 3)
-    unit = space.sample_unit(k_unit, population)
-    state = trainer.init_population(k_init, train_x[:2], population)
+
+    disc = tuple(bool(b) for b in space.discrete_mask())
+    g_chunk = generations if gen_chunk <= 0 else min(gen_chunk, generations)
+    # balanced split: ceil(G/chunk) launches whose lengths differ by at
+    # most 1 (e.g. G=3, chunk=2 -> [2, 1]; G=7, chunk=3 -> [3, 2, 2]),
+    # so a non-dividing chunk costs one extra compile, never more
+    n_launches = -(-generations // g_chunk)
+    base, rem = divmod(generations, n_launches)
+    launch_lens = [base + 1] * rem + [base] * (n_launches - rem)
+
+    # restore BEFORE initializing: a resumed sweep must not pay (or
+    # transiently hold the memory of) a full-population init it discards
+    snap = None
+    restored = None
+    start_launch = 0
+    best_parts, mean_parts = [], []
+    scores = None
+    if checkpoint_dir is not None:
+        import dataclasses
+
+        from mpi_opt_tpu.utils.checkpoint import SweepCheckpointer
+
+        snap = SweepCheckpointer(
+            checkpoint_dir,
+            {
+                "workload": getattr(workload, "name", type(workload).__name__),
+                "population": population,
+                "generations": generations,
+                "steps_per_gen": steps_per_gen,
+                "seed": seed,
+                "launch_lens": launch_lens,
+                "member_chunk": member_chunk,
+                # PBT knobs change exploit/explore behavior: resuming under
+                # a different cfg would not be the continuation we promise
+                "cfg": dataclasses.asdict(cfg),
+            },
+        )
+        restored = snap.restore_population_sweep()
+        if restored is not None:
+            state, unit, k_run, scores, meta = restored
+            best_parts = [np.asarray(v, dtype=np.float32) for v in meta["best"]]
+            mean_parts = [np.asarray(v, dtype=np.float32) for v in meta["mean"]]
+            start_launch = int(meta["launches_done"])
+    if restored is None:
+        unit = space.sample_unit(k_unit, population)
+        state = trainer.init_population(k_init, train_x[:2], population)
     if mesh is not None:
         from mpi_opt_tpu.parallel.mesh import pop_sharding
 
@@ -160,44 +204,7 @@ def fused_pbt(
     # workload cache above so its identity is stable across calls
     hparams_fn = _HParamsFn(space, workload)
 
-    disc = tuple(bool(b) for b in space.discrete_mask())
-    g_chunk = generations if gen_chunk <= 0 else min(gen_chunk, generations)
-    # balanced split: ceil(G/chunk) launches whose lengths differ by at
-    # most 1 (e.g. G=3, chunk=2 -> [2, 1]; G=7, chunk=3 -> [3, 2, 2]),
-    # so a non-dividing chunk costs one extra compile, never more
-    n_launches = -(-generations // g_chunk)
-    base, rem = divmod(generations, n_launches)
-    launch_lens = [base + 1] * rem + [base] * (n_launches - rem)
-
-    snap = None
-    start_launch = 0
-    best_parts, mean_parts = [], []
-    scores = None
-    if checkpoint_dir is not None:
-        import dataclasses
-
-        sweep_config = {
-            "workload": getattr(workload, "name", type(workload).__name__),
-            "population": population,
-            "generations": generations,
-            "steps_per_gen": steps_per_gen,
-            "seed": seed,
-            "launch_lens": launch_lens,
-            "member_chunk": member_chunk,
-            # PBT knobs change exploit/explore behavior: resuming under a
-            # different cfg would not be the continuation we promise
-            "cfg": dataclasses.asdict(cfg),
-        }
-        snap = _SweepCheckpointer(checkpoint_dir, sweep_config, max(1, snapshot_every))
-        restored = snap.restore()
-        if restored is not None:
-            state, unit, k_run, scores, best_parts, mean_parts, start_launch = restored
-            if mesh is not None:
-                from mpi_opt_tpu.parallel.mesh import pop_sharding
-
-                state = shard_popstate(state, mesh)
-                unit = jax.device_put(unit, pop_sharding(mesh))
-
+    snapshot_every = max(1, snapshot_every)
     try:
         for i in range(start_launch, n_launches):
             # k_run is the scan-carried key returned by the previous
@@ -222,9 +229,15 @@ def fused_pbt(
             best_parts.append(np.asarray(best))
             mean_parts.append(np.asarray(mean))
             scores = np.asarray(final_scores)
-            if snap is not None:
-                snap.maybe_save(i + 1, n_launches, state, unit, k_run, scores,
-                                best_parts, mean_parts)
+            if snap is not None and ((i + 1) % snapshot_every == 0 or i + 1 == n_launches):
+                snap.save_population_sweep(
+                    i + 1, state, unit, k_run, scores,
+                    meta_extra={
+                        "launches_done": i + 1,
+                        "best": [v.tolist() for v in best_parts],
+                        "mean": [v.tolist() for v in mean_parts],
+                    },
+                )
     finally:
         if snap is not None:
             snap.close()
@@ -259,103 +272,3 @@ class _HParamsFn:
         return isinstance(other, _HParamsFn) and (
             self.space is other.space and self.workload is other.workload
         )
-
-
-class _SweepCheckpointer:
-    """Durable launch-granular snapshots of a fused sweep.
-
-    Items per orbax step (= completed launch count):
-    - ``sweep`` (StandardSave): host copies of the carried population
-      state, unit hparams, RNG key data, and the last generation's
-      scores. Host-fetched BEFORE saving because the next launch
-      donates the device buffers out from under an async writer.
-    - ``meta`` (JsonSave): the sweep config (validated on restore — a
-      checkpoint from a different sweep shape must not silently load)
-      plus completed-launch curves.
-    """
-
-    def __init__(self, directory: str, config: dict, every: int):
-        import os
-
-        import orbax.checkpoint as ocp
-
-        self._ocp = ocp
-        self.config = config
-        self.every = every
-        self._mgr = ocp.CheckpointManager(
-            os.path.abspath(directory),
-            options=ocp.CheckpointManagerOptions(max_to_keep=2, create=True),
-        )
-
-    def maybe_save(self, launches_done, n_launches, state, unit, key, scores,
-                   best_parts, mean_parts):
-        import numpy as np
-
-        last = launches_done == n_launches
-        if launches_done % self.every and not last:
-            return
-        host = jax.device_get(
-            {"params": state.params, "momentum": state.momentum, "step": state.step}
-        )
-        sweep = {
-            "state": host,
-            "unit": np.asarray(unit),
-            "key_data": np.asarray(jax.random.key_data(key)),
-            "scores": np.asarray(scores),
-        }
-        meta = {
-            "config": self.config,
-            "launches_done": launches_done,
-            "best": [v.tolist() for v in best_parts],
-            "mean": [v.tolist() for v in mean_parts],
-        }
-        self._mgr.save(
-            launches_done,
-            args=self._ocp.args.Composite(
-                sweep=self._ocp.args.StandardSave(sweep),
-                meta=self._ocp.args.JsonSave(meta),
-            ),
-        )
-
-    def restore(self):
-        """(state, unit, key, scores, best_parts, mean_parts, launches_done)
-        from the latest snapshot, or None if the directory is empty.
-        Raises ValueError on a config mismatch."""
-        import numpy as np
-
-        step = self._mgr.latest_step()
-        if step is None:
-            return None
-        r = self._mgr.restore(
-            step,
-            args=self._ocp.args.Composite(
-                sweep=self._ocp.args.StandardRestore(),
-                meta=self._ocp.args.JsonRestore(),
-            ),
-        )
-        if r.meta["config"] != self.config:
-            raise ValueError(
-                "checkpoint directory holds a different sweep: "
-                f"saved config {r.meta['config']} vs requested {self.config}"
-            )
-        state = PopState(
-            params=r.sweep["state"]["params"],
-            momentum=r.sweep["state"]["momentum"],
-            step=r.sweep["state"]["step"],
-        )
-        key = jax.random.wrap_key_data(jnp.asarray(r.sweep["key_data"]))
-        best_parts = [np.asarray(v, dtype=np.float32) for v in r.meta["best"]]
-        mean_parts = [np.asarray(v, dtype=np.float32) for v in r.meta["mean"]]
-        return (
-            state,
-            r.sweep["unit"],
-            key,
-            np.asarray(r.sweep["scores"]),
-            best_parts,
-            mean_parts,
-            int(r.meta["launches_done"]),
-        )
-
-    def close(self):
-        self._mgr.wait_until_finished()
-        self._mgr.close()

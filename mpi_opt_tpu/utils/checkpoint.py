@@ -106,3 +106,108 @@ class SearchCheckpointer:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class SweepCheckpointer:
+    """Durable snapshots of a fused on-device sweep, at the sweep's own
+    granularity (PBT: launches; SHA: rungs; Hyperband: brackets via
+    per-bracket directories).
+
+    Items per orbax step:
+    - ``sweep`` (StandardSave): host copies of the carried arrays
+      (population state, unit hparams, RNG key data, scores...).
+      Callers host-fetch BEFORE saving: the next launch may donate the
+      device buffers out from under orbax's async writer.
+    - ``meta`` (JsonSave): ``{"config": ..., **extra}`` — the sweep
+      config is validated on restore, so a checkpoint from a different
+      sweep shape raises instead of silently loading.
+    """
+
+    def __init__(self, directory: str, config: dict, keep: int = 2):
+        self.config = config
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep, create=True),
+        )
+
+    def save(self, step: int, sweep: dict, meta_extra: dict) -> None:
+        meta = {"config": self.config, **meta_extra}
+        self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                sweep=ocp.args.StandardSave(sweep), meta=ocp.args.JsonSave(meta)
+            ),
+        )
+
+    def restore(self):
+        """(sweep_arrays, meta) from the latest snapshot, or None.
+        Raises ValueError on a config mismatch."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        r = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                sweep=ocp.args.StandardRestore(), meta=ocp.args.JsonRestore()
+            ),
+        )
+        if r.meta["config"] != self.config:
+            raise ValueError(
+                "checkpoint directory holds a different sweep: "
+                f"saved config {r.meta['config']} vs requested {self.config}"
+            )
+        return r.sweep, r.meta
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+    # -- population-sweep payload (shared by fused PBT / SHA) -------------
+
+    def save_population_sweep(self, step, state, unit, key, scores, meta_extra):
+        """Snapshot the standard fused-sweep payload. Host-fetches the
+        population state BEFORE the async save (the caller's next launch
+        donates those device buffers)."""
+        import jax
+        import numpy as np
+
+        host = jax.device_get(
+            {"params": state.params, "momentum": state.momentum, "step": state.step}
+        )
+        self.save(
+            step,
+            sweep={
+                "state": host,
+                "unit": np.asarray(unit),
+                "key_data": np.asarray(jax.random.key_data(key)),
+                "scores": np.asarray(scores),
+            },
+            meta_extra=meta_extra,
+        )
+
+    def restore_population_sweep(self):
+        """(PopState, unit, key, scores, meta) from the latest snapshot,
+        or None. Raises ValueError (and closes the manager — the caller
+        never reaches its own close on this path) on config mismatch."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from mpi_opt_tpu.train.population import PopState
+
+        try:
+            r = self.restore()
+        except ValueError:
+            self.close()
+            raise
+        if r is None:
+            return None
+        sweep, meta = r
+        state = PopState(
+            params=sweep["state"]["params"],
+            momentum=sweep["state"]["momentum"],
+            step=sweep["state"]["step"],
+        )
+        key = jax.random.wrap_key_data(jnp.asarray(sweep["key_data"]))
+        return state, sweep["unit"], key, np.asarray(sweep["scores"]), meta

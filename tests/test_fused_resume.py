@@ -68,3 +68,67 @@ def test_checkpoint_config_mismatch_raises(tmp_path):
     other = dict(KW, seed=KW["seed"] + 1)
     with pytest.raises(ValueError, match="different sweep"):
         fp.fused_pbt(wl, checkpoint_dir=ckpt, **other)
+
+
+def test_sha_crash_resume_bit_identical(tmp_path, monkeypatch):
+    """Rung-granular SHA recovery: kill after rung 2, resume, and the
+    final result must equal the uninterrupted sweep exactly."""
+    import mpi_opt_tpu.train.fused_asha as fa
+
+    wl = _wl()
+    kw = dict(n_trials=9, min_budget=2, max_budget=18, eta=3, seed=4)
+    whole = fa.fused_sha(wl, **kw)
+
+    real = fa._cut_and_gather
+    calls = {"n": 0}
+
+    def crashing(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 2:  # die at the second rung's cut
+            raise RuntimeError("simulated TPU worker crash")
+        return real(*a, **k)
+
+    ckpt = str(tmp_path / "sha")
+    monkeypatch.setattr(fa, "_cut_and_gather", crashing)
+    with pytest.raises(RuntimeError, match="simulated"):
+        fa.fused_sha(wl, checkpoint_dir=ckpt, **kw)
+    monkeypatch.setattr(fa, "_cut_and_gather", real)
+
+    resumed = fa.fused_sha(wl, checkpoint_dir=ckpt, **kw)
+    assert resumed["best_score"] == whole["best_score"]
+    assert resumed["best_trial"] == whole["best_trial"]
+    np.testing.assert_array_equal(resumed["stop_rung"], whole["stop_rung"])
+    np.testing.assert_array_equal(resumed["last_score"], whole["last_score"])
+    assert resumed["best_params"] == whole["best_params"]
+
+
+def test_sha_resume_after_completion(tmp_path, monkeypatch):
+    import mpi_opt_tpu.train.fused_asha as fa
+
+    wl = _wl()
+    kw = dict(n_trials=6, min_budget=2, max_budget=6, eta=3, seed=5)
+    ckpt = str(tmp_path / "sha")
+    first = fa.fused_sha(wl, checkpoint_dir=ckpt, **kw)
+
+    def boom(*a, **k):
+        raise AssertionError("completed sweep re-trained a rung")
+
+    # a completed sweep must replay from its final snapshot without
+    # touching the trainer
+    monkeypatch.setattr(type(fa.workload_arrays(wl, 0, None)[0]), "train_segment",
+                        property(lambda self: boom), raising=False)
+    again = fa.fused_sha(wl, checkpoint_dir=ckpt, **kw)
+    assert again["best_score"] == first["best_score"]
+    assert again["best_trial"] == first["best_trial"]
+
+
+def test_sha_checkpoint_config_mismatch_raises(tmp_path):
+    import mpi_opt_tpu.train.fused_asha as fa
+
+    wl = _wl()
+    ckpt = str(tmp_path / "sha")
+    fa.fused_sha(wl, n_trials=6, min_budget=2, max_budget=6, eta=3, seed=5,
+                 checkpoint_dir=ckpt)
+    with pytest.raises(ValueError, match="different sweep"):
+        fa.fused_sha(wl, n_trials=9, min_budget=2, max_budget=6, eta=3, seed=5,
+                     checkpoint_dir=ckpt)

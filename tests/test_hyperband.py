@@ -95,3 +95,36 @@ def test_fused_hyperband():
     assert res["brackets"][0]["start_budget"] < res["brackets"][-1]["start_budget"]
     # overall best is the max over brackets
     assert res["best_score"] == max(b["best_score"] for b in res["brackets"])
+
+
+def test_fused_hyperband_checkpoint_resume(tmp_path, monkeypatch):
+    """Bracket-granular recovery: each bracket checkpoints its rungs in
+    its own subdirectory; completed brackets replay without re-running."""
+    import mpi_opt_tpu.train.fused_asha as fa
+    from mpi_opt_tpu.train.fused_asha import fused_hyperband
+
+    wl = get_workload("fashion_mlp", n_train=256, n_val=128)
+    kw = dict(max_budget=6, eta=3, seed=2)
+    whole = fused_hyperband(wl, **kw)
+
+    real = fa.fused_sha
+    calls = {"n": 0}
+
+    def crashing(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 2:  # die inside the second bracket
+            raise RuntimeError("simulated crash")
+        return real(*a, **k)
+
+    ckpt = str(tmp_path / "hb")
+    monkeypatch.setattr(fa, "fused_sha", crashing)
+    with pytest.raises(RuntimeError, match="simulated"):
+        fused_hyperband(wl, checkpoint_dir=ckpt, **kw)
+    monkeypatch.setattr(fa, "fused_sha", real)
+
+    resumed = fused_hyperband(wl, checkpoint_dir=ckpt, **kw)
+    assert resumed["best_score"] == whole["best_score"]
+    assert resumed["n_trials"] == whole["n_trials"]
+    assert [b["best_score"] for b in resumed["brackets"]] == [
+        b["best_score"] for b in whole["brackets"]
+    ]
