@@ -75,7 +75,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--fused",
         action="store_true",
-        help="run the whole sweep on-device (pbt/asha/hyperband): no "
+        help="run the whole sweep on-device (pbt/asha/hyperband/tpe): no "
         "driver round-trips, population never leaves the device; "
         "--checkpoint-dir makes it crash-recoverable (pbt: launch "
         "granularity, asha/hyperband: rung granularity)",
@@ -180,6 +180,20 @@ def run_fused(args, parser, workload) -> int:
             )
             n_trials = res["n_trials"]
             extra = {"rung_sizes": res["rung_sizes"], "rung_budgets": res["rung_budgets"]}
+        elif args.algorithm == "tpe":
+            from mpi_opt_tpu.train.fused_tpe import fused_tpe
+
+            res = fused_tpe(
+                workload,
+                n_trials=args.trials,
+                batch=args.population,
+                budget=args.budget,
+                seed=args.seed,
+                member_chunk=args.member_chunk,
+                checkpoint_dir=args.checkpoint_dir,
+            )
+            n_trials = res["n_trials"]
+            extra = {"best_curve": [round(float(v), 4) for v in res["best_curve"]]}
         elif args.algorithm == "hyperband":
             from mpi_opt_tpu.train.fused_asha import fused_hyperband
 
@@ -194,7 +208,7 @@ def run_fused(args, parser, workload) -> int:
             n_trials = res["n_trials"]
             extra = {"brackets": res["brackets"]}
         else:
-            parser.error(f"--fused supports pbt/asha/hyperband, not {args.algorithm!r}")
+            parser.error(f"--fused supports pbt/asha/hyperband/tpe, not {args.algorithm!r}")
     wall = time.perf_counter() - t0
     metrics.count_trials(n_trials)
     summary = {

@@ -29,3 +29,25 @@ def workload_arrays(workload, member_chunk: int = 0, mesh=None):
             jnp.asarray(d["val_y"]),
         )
     return workload._fused_cache[1:]
+
+
+class HParamsFn:
+    """Hashable (space, workload)-bound unit->OptHParams mapping, usable
+    as a static jit argument (identity-hashed: space/workload come from
+    per-workload caches, so identity is stable across calls and a fresh
+    pair correctly forces a retrace)."""
+
+    def __init__(self, space, workload):
+        self.space = space
+        self.workload = workload
+
+    def __call__(self, unit):
+        return self.workload.make_hparams(self.space.from_unit(unit))
+
+    def __hash__(self):
+        return hash((id(self.space), id(self.workload)))
+
+    def __eq__(self, other):
+        return isinstance(other, HParamsFn) and (
+            self.space is other.space and self.workload is other.workload
+        )

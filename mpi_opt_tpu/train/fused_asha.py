@@ -29,7 +29,6 @@ from __future__ import annotations
 import functools
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from mpi_opt_tpu.ops.asha import asha_cut, asha_rungs

@@ -202,7 +202,9 @@ def fused_pbt(
 
     # hparams_fn must be hashable-static; space comes from the per-
     # workload cache above so its identity is stable across calls
-    hparams_fn = _HParamsFn(space, workload)
+    from mpi_opt_tpu.train.common import HParamsFn
+
+    hparams_fn = HParamsFn(space, workload)
 
     snapshot_every = max(1, snapshot_every)
     try:
@@ -252,23 +254,3 @@ def fused_pbt(
         "state": state,
         "unit": np.asarray(unit),
     }
-
-
-class _HParamsFn:
-    """Hashable (space, workload)-bound unit->OptHParams mapping, usable
-    as a static jit argument."""
-
-    def __init__(self, space, workload):
-        self.space = space
-        self.workload = workload
-
-    def __call__(self, unit: jax.Array) -> OptHParams:
-        return self.workload.make_hparams(self.space.from_unit(unit))
-
-    def __hash__(self):
-        return hash((id(self.space), id(self.workload)))
-
-    def __eq__(self, other):
-        return isinstance(other, _HParamsFn) and (
-            self.space is other.space and self.workload is other.workload
-        )

@@ -152,6 +152,9 @@ class SweepCheckpointer:
             ),
         )
         if r.meta["config"] != self.config:
+            # close before raising: callers only reach their own close()
+            # via try/finally blocks entered AFTER a successful restore
+            self.close()
             raise ValueError(
                 "checkpoint directory holds a different sweep: "
                 f"saved config {r.meta['config']} vs requested {self.config}"
@@ -188,19 +191,15 @@ class SweepCheckpointer:
 
     def restore_population_sweep(self):
         """(PopState, unit, key, scores, meta) from the latest snapshot,
-        or None. Raises ValueError (and closes the manager — the caller
-        never reaches its own close on this path) on config mismatch."""
+        or None. Raises ValueError on config mismatch (restore() closes
+        the manager on that path)."""
         import jax
         import jax.numpy as jnp
         import numpy as np
 
         from mpi_opt_tpu.train.population import PopState
 
-        try:
-            r = self.restore()
-        except ValueError:
-            self.close()
-            raise
+        r = self.restore()
         if r is None:
             return None
         sweep, meta = r

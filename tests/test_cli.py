@@ -107,3 +107,24 @@ def test_fused_rejects_non_population_workload():
 def test_fused_rejects_random_algorithm():
     with pytest.raises(SystemExit):
         main(["--workload", "fashion_mlp", "--algorithm", "random", "--fused"])
+
+
+def test_fused_tpe_cli(capsys):
+    rc = main(
+        [
+            "--workload", "fashion_mlp",
+            "--algorithm", "tpe",
+            "--fused",
+            "--trials", "8",
+            "--population", "4",
+            "--budget", "5",
+            "--seed", "0",
+        ]
+    )
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.strip().splitlines() if l.startswith("{")]
+    summary = json.loads(lines[-1])
+    assert summary["backend"] == "fused"
+    assert summary["n_trials"] == 8
+    assert len(summary["best_curve"]) == 2
+    assert 0.0 <= summary["best_score"] <= 1.0
