@@ -280,15 +280,30 @@ def bench_cpu_baseline(steps, seed, n_workers, cache_path="CPU_BASELINE.json",
     from mpi_opt_tpu.trial import Trial
     from mpi_opt_tpu.workloads import get_workload
 
+    # cache key covers everything that changes the measured number: the
+    # workload/model, the measurement protocol (b_small/b_large +
+    # extrapolation scheme, versioned), and the run shape — a stale
+    # cache must re-measure, not silently feed the headline vs_baseline
+    # (ADVICE round 2)
+    workload_name = "cifar10_cnn"
+    protocol = 2  # bump when the measurement scheme changes
+    cache_key = {
+        "steps": steps,
+        "n_workers": n_workers,
+        "workload": workload_name,
+        "b_small": b_small,
+        "b_large": b_large,
+        "protocol": protocol,
+    }
     if _os.path.exists(cache_path):
         with open(cache_path) as f:
             rec = _json.load(f)
-        if rec.get("steps") == steps and rec.get("n_workers") == n_workers:
+        if all(rec.get(k) == v for k, v in cache_key.items()):
             log(f"[bench] cpu baseline from {cache_path}: "
                 f"{rec['pool_trials_per_sec']:.6f} trials/s ({rec['provenance']})")
             return rec["pool_trials_per_sec"]
 
-    wl = get_workload("cifar10_cnn")
+    wl = get_workload(workload_name)
     space = wl.default_space()
     be = CPUBackend(wl, n_workers=n_workers, seed=seed)
 
@@ -336,10 +351,7 @@ def bench_cpu_baseline(steps, seed, n_workers, cache_path="CPU_BASELINE.json",
     )
     log(f"[bench] cpu: {provenance} -> {pool_tps:.6f} trials/s ({n_workers} procs)")
     rec = {
-        "steps": steps,
-        "n_workers": n_workers,
-        "b_small": b_small,
-        "b_large": b_large,
+        **cache_key,
         "cost_small_s": round(c_small, 2),
         "cost_large_s": round(c_large, 2),
         "slope_s_per_step": round(slope, 3),

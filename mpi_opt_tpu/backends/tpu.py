@@ -55,6 +55,7 @@ class TPUPopulationBackend(Backend):
         member_chunk: int = 0,
         slot_slack: int = 2,
         eval_chunk: int = 1024,
+        mesh=None,
     ):
         if not hasattr(workload, "make_trainer"):
             raise ValueError(
@@ -66,10 +67,21 @@ class TPUPopulationBackend(Backend):
         self.seed = seed
         self.member_chunk = member_chunk
         self.eval_chunk = eval_chunk
+        # optional ('pop','data') mesh: the slot pool shards its member
+        # axis over 'pop' and batches constrain over 'data', so the
+        # driver path reaches the same mesh layer the fused sweeps use
+        self.mesh = mesh
         # slack >= 2 guarantees every batch can pin its sources (<= pop)
         # AND allocate its outputs (<= pop) without evicting a pinned
         # slot; +1 scratch slot absorbs padding writes
         self.pool_size = population * max(2, slot_slack) + 1
+        if mesh is not None:
+            # the pool only shards if its slot axis divides the 'pop'
+            # axis (shard_popstate falls back to replication otherwise,
+            # which would silently defeat the mesh); round up — extra
+            # slots just enlarge the free list
+            n_pop = mesh.shape["pop"]
+            self.pool_size = -(-self.pool_size // n_pop) * n_pop
         self._scratch = self.pool_size - 1
         self._setup_done = False
         self._step_counter = 0
@@ -86,19 +98,35 @@ class TPUPopulationBackend(Backend):
     def _setup(self):
         if self._setup_done:
             return
-        d = self.workload.data()
-        self._trainer = self.workload.make_trainer(member_chunk=self.member_chunk)
-        self._space = self.workload.default_space()
-        self._train_x = jnp.asarray(d["train_x"])
-        self._train_y = jnp.asarray(d["train_y"])
-        self._val_x = jnp.asarray(d["val_x"])
-        self._val_y = jnp.asarray(d["val_y"])
+        # single placement point shared with the fused sweeps: trainer
+        # built for (member_chunk, mesh), datasets device-resident and
+        # mesh-replicated (train/common.py)
+        from mpi_opt_tpu.train.common import workload_arrays
+
+        (
+            self._trainer,
+            self._space,
+            self._train_x,
+            self._train_y,
+            self._val_x,
+            self._val_y,
+        ) = workload_arrays(self.workload, self.member_chunk, self.mesh)
         key = jax.random.fold_in(jax.random.key(self.seed), 7001)
         self._pool = self._trainer.init_population(
             key, self._train_x[:2], self.pool_size
         )
+        self._pool = self._place_pool(self._pool)
         self._free = [s for s in range(self.pool_size) if s != self._scratch]
         self._setup_done = True
+
+    def _place_pool(self, pool):
+        """Shard the slot pool's member axis over the mesh 'pop' axis
+        (no-op without a mesh, and zero-copy when already placed)."""
+        if self.mesh is None:
+            return pool
+        from mpi_opt_tpu.parallel.mesh import shard_popstate
+
+        return shard_popstate(pool, self.mesh)
 
     # -- slot management --------------------------------------------------
 
@@ -196,6 +224,14 @@ class TPUPopulationBackend(Backend):
 
         # device program: gather -> fresh-overwrite -> train -> eval -> scatter
         sub = self._trainer.gather_members(self._pool, jnp.asarray(gather_idx))
+        if self.mesh is not None and n_pad % self.mesh.shape["pop"] == 0:
+            # the gather's output layout follows XLA's guess; re-place so
+            # the group trains sharded over 'pop' (skipped for groups
+            # smaller than the axis — they run replicated, which is
+            # correct, just not parallel)
+            from mpi_opt_tpu.parallel.mesh import shard_popstate
+
+            sub = shard_popstate(sub, self.mesh)
         if fresh[:n].any():  # steady-state resume/inherit batches skip init
             fresh_states = self._trainer.init_population(k_init, self._train_x[:2], n_pad)
             sub = self._trainer.select_members(jnp.asarray(fresh), fresh_states, sub)
@@ -207,7 +243,7 @@ class TPUPopulationBackend(Backend):
         scores = self._trainer.eval_population(
             sub, self._val_x, self._val_y, eval_chunk=self.eval_chunk
         )
-        self._pool = _scatter(self._pool, sub, jnp.asarray(out_slots))
+        self._pool = self._place_pool(_scatter(self._pool, sub, jnp.asarray(out_slots)))
 
         scores = np.asarray(scores)
         wall = time.perf_counter() - t0
@@ -277,7 +313,7 @@ class TPUPopulationBackend(Backend):
         # free the freshly-initialized pool BEFORE uploading the restored
         # one: a ResNet-scale pool cannot afford 2x residency
         self._pool = None
-        self._pool = jax.tree.map(jnp.asarray, pool)
+        self._pool = self._place_pool(jax.tree.map(jnp.asarray, pool))
 
 
 @functools.partial(jax.jit, donate_argnames=("pool",))

@@ -94,7 +94,50 @@ def build_parser() -> argparse.ArgumentParser:
         help="fused pbt: generations per program launch (bit-identical "
         "split; needed where single programs are time-limited)",
     )
+    # mesh / multi-chip (SURVEY.md §2 row 9: the communication layer,
+    # reachable from the user surface)
+    p.add_argument(
+        "--n-data",
+        type=int,
+        default=1,
+        help="mesh 'data' axis size: within-member data parallelism "
+        "(gradient all-reduce over ICI). Devices are split as "
+        "(devices/n_data) x n_data",
+    )
+    p.add_argument(
+        "--n-pop",
+        type=int,
+        default=0,
+        help="mesh 'pop' axis size (0 = all remaining devices). "
+        "Population/trial parallelism axis",
+    )
+    p.add_argument(
+        "--no-mesh",
+        action="store_true",
+        help="disable the automatic ('pop','data') mesh on multi-device "
+        "hosts (run single-device)",
+    )
     return p
+
+
+def build_mesh(args):
+    """The run's device mesh, or None for plain single-device execution.
+
+    Auto-meshes whenever more than one device is visible (a v4-32 user
+    typing ``--fused`` gets all 32 chips without extra flags); explicit
+    ``--n-data``/``--n-pop`` force a mesh shape, ``--no-mesh`` opts out.
+    """
+    if args.no_mesh:
+        if args.n_data > 1 or args.n_pop > 0:
+            raise SystemExit("--no-mesh contradicts --n-data/--n-pop")
+        return None
+    import jax
+
+    if jax.device_count() > 1 or args.n_data > 1 or args.n_pop > 0:
+        from mpi_opt_tpu.parallel.mesh import make_mesh
+
+        return make_mesh(n_pop=args.n_pop or None, n_data=args.n_data)
+    return None
 
 
 def make_algorithm(args, space):
@@ -126,6 +169,23 @@ def make_algorithm(args, space):
     raise AssertionError(args.algorithm)
 
 
+def _has_snapshot(directory) -> bool:
+    """Does an orbax sweep snapshot already live under ``directory``?
+
+    Orbax lays out one numeric subdirectory per saved step (hyperband
+    nests them under per-bracket dirs), so any digit-named directory in
+    the tree means a previous sweep left state here.
+    """
+    import os
+
+    if not directory or not os.path.isdir(directory):
+        return False
+    for _root, dirs, _files in os.walk(directory):
+        if any(d.isdigit() for d in dirs):
+            return True
+    return False
+
+
 def run_fused(args, parser, workload) -> int:
     """--fused: the whole sweep as on-device programs, no driver loop.
 
@@ -142,9 +202,21 @@ def run_fused(args, parser, workload) -> int:
 
     if not isinstance(workload, PopulationWorkload):
         parser.error(f"--fused requires a population workload, not {args.workload!r}")
-    import jax
+    # resuming is explicit opt-in, matching the driver path: a stale
+    # checkpoint dir must not silently replay an old sweep (ADVICE r2)
+    if args.checkpoint_dir and not args.resume and _has_snapshot(args.checkpoint_dir):
+        parser.error(
+            f"--checkpoint-dir {args.checkpoint_dir!r} already holds a sweep "
+            "snapshot; pass --resume to continue it, or point at a fresh "
+            "directory"
+        )
 
-    n_chips = jax.local_device_count()
+    mesh = build_mesh(args)
+    # per-chip accounting divides by the devices the sweep ACTUALLY runs
+    # on: the mesh's devices when sharded, exactly 1 otherwise (dividing
+    # by local_device_count would understate per-chip throughput on a
+    # multi-chip host running --no-mesh; ADVICE round 2)
+    n_chips = mesh.devices.size if mesh is not None else 1
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
     t0 = time.perf_counter()
     with profile_window(args.profile_dir):
@@ -158,6 +230,7 @@ def run_fused(args, parser, workload) -> int:
                 steps_per_gen=args.steps_per_generation,
                 seed=args.seed,
                 cfg=PBTConfig(truncation_frac=args.truncation),
+                mesh=mesh,
                 member_chunk=args.member_chunk,
                 gen_chunk=args.gen_chunk,
                 checkpoint_dir=args.checkpoint_dir,
@@ -176,6 +249,7 @@ def run_fused(args, parser, workload) -> int:
                 eta=args.eta,
                 seed=args.seed,
                 member_chunk=args.member_chunk,
+                mesh=mesh,
                 checkpoint_dir=args.checkpoint_dir,
             )
             n_trials = res["n_trials"]
@@ -190,6 +264,7 @@ def run_fused(args, parser, workload) -> int:
                 budget=args.budget,
                 seed=args.seed,
                 member_chunk=args.member_chunk,
+                mesh=mesh,
                 checkpoint_dir=args.checkpoint_dir,
             )
             n_trials = res["n_trials"]
@@ -203,6 +278,7 @@ def run_fused(args, parser, workload) -> int:
                 eta=args.eta,
                 seed=args.seed,
                 member_chunk=args.member_chunk,
+                mesh=mesh,
                 checkpoint_dir=args.checkpoint_dir,
             )
             n_trials = res["n_trials"]
@@ -215,6 +291,8 @@ def run_fused(args, parser, workload) -> int:
         "workload": args.workload,
         "algorithm": args.algorithm,
         "backend": "fused",
+        "mesh": None if mesh is None else dict(mesh.shape),
+        "n_chips": n_chips,
         "n_trials": n_trials,
         "wall_s": round(wall, 3),
         "trials_per_sec_per_chip": round(n_trials / max(wall, 1e-9) / n_chips, 4),
@@ -239,23 +317,24 @@ def main(argv=None) -> int:
         return run_fused(args, parser, workload)
     space = workload.default_space()
     algorithm = make_algorithm(args, space)
+    mesh = None
     backend_kwargs = {}
     if args.backend == "cpu":
         backend_kwargs = {"n_workers": args.workers, "seed": args.seed}
     elif args.backend == "tpu":
-        backend_kwargs = {"population": args.population, "seed": args.seed}
+        mesh = build_mesh(args)
+        backend_kwargs = {"population": args.population, "seed": args.seed, "mesh": mesh}
     backend = get_backend(args.backend, workload, **backend_kwargs)
     # the metric of record is trials/sec/CHIP; normalizing by 1 on a
-    # multi-chip TPU run would overstate it by the chip count. Local
-    # devices, not global: each host's driver counts only its own
+    # multi-chip TPU run would overstate it by the chip count, and by
+    # the device count on a --no-mesh run that only uses one device —
+    # so count the devices the slot pool is actually sharded over.
+    # Local devices, not global: each host's driver counts only its own
     # trials, so dividing by the global count would understate per-chip
-    # throughput by the host count. (On 2-core-per-chip generations this
-    # is per-core, the conservative direction.)
+    # throughput by the host count.
     n_chips = 1
-    if args.backend == "tpu":
-        import jax
-
-        n_chips = jax.local_device_count()
+    if args.backend == "tpu" and mesh is not None:
+        n_chips = mesh.devices.size
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
     checkpointer = None
     if args.checkpoint_dir:

@@ -69,9 +69,50 @@ def replicate(mesh: Mesh) -> NamedSharding:
 def shard_popstate(state: Any, mesh: Mesh) -> Any:
     """Place a PopState (or any pytree with leading member axes) so the
     member axis is sharded over ``pop`` and everything else replicated
-    across ``data``."""
+    across ``data``.
+
+    Leaves whose member axis does not divide the ``pop`` axis replicate
+    instead (XLA's device_put rejects uneven shards): correct, just not
+    member-parallel — this happens for e.g. an SHA first cohort of 9
+    trials on an 8-way mesh, whose later (rounded) rungs shard fully.
+    """
+    n_pop = mesh.shape["pop"]
+    sh, rep = pop_sharding(mesh), replicate(mesh)
+    return jax.tree.map(
+        lambda x: jax.device_put(x, sh if x.shape[0] % n_pop == 0 else rep), state
+    )
+
+
+def place_pop(x: jax.Array, mesh: Mesh) -> jax.Array:
+    """Place one array's leading axis over ``pop`` (replicates when the
+    axis does not divide — see ``shard_popstate``)."""
+    if x.shape[0] % mesh.shape["pop"] == 0:
+        return jax.device_put(x, pop_sharding(mesh))
+    return jax.device_put(x, replicate(mesh))
+
+
+def constrain_pop(tree: Any, mesh: Optional[Mesh]) -> Any:
+    """Sharding *constraint* over ``pop`` on every leaf's leading axis.
+
+    The in-jit counterpart of ``shard_popstate`` (device_put is a
+    host-side placement; inside a traced computation the layout is
+    requested with ``with_sharding_constraint`` and the SPMD partitioner
+    obliges). Used where population state is *created inside* a fused
+    program — e.g. fused TPE initializes each generation's fresh cohort
+    on-device — so the members land sharded instead of wherever
+    propagation guesses. No-op without a mesh; non-dividing member axes
+    (a TPE tail generation) are left to the partitioner's choice.
+    """
+    if mesh is None:
+        return tree
+    n_pop = mesh.shape["pop"]
     sh = pop_sharding(mesh)
-    return jax.tree.map(lambda x: jax.device_put(x, sh), state)
+    return jax.tree.map(
+        lambda x: jax.lax.with_sharding_constraint(x, sh)
+        if x.shape[0] % n_pop == 0
+        else x,
+        tree,
+    )
 
 
 def initialize_multihost(

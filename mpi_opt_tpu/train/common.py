@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -14,19 +15,31 @@ def workload_arrays(workload, member_chunk: int = 0, mesh=None):
     guaranteed retrace; the device arrays ride along so the dataset is
     uploaded once per search. ``mesh`` is part of the cache key: a
     meshed trainer constrains its batches over the 'data' axis, which
-    changes the compiled program.
+    changes the compiled program — and with a mesh the datasets come
+    back replicated across it (every shard samples the same shared
+    minibatch; the trainer's in-program constraint then splits each
+    batch over 'data'). This is the single placement point for fused
+    sweep data — don't re-place at call sites.
     """
     cache = getattr(workload, "_fused_cache", None)
     if cache is None or cache[0] != (member_chunk, mesh):
         d = workload.data()
-        workload._fused_cache = (
-            (member_chunk, mesh),
-            workload.make_trainer(member_chunk=member_chunk, mesh=mesh),
-            workload.default_space(),
+        arrays = (
             jnp.asarray(d["train_x"]),
             jnp.asarray(d["train_y"]),
             jnp.asarray(d["val_x"]),
             jnp.asarray(d["val_y"]),
+        )
+        if mesh is not None:
+            from mpi_opt_tpu.parallel.mesh import replicate
+
+            rep = replicate(mesh)
+            arrays = tuple(jax.device_put(a, rep) for a in arrays)
+        workload._fused_cache = (
+            (member_chunk, mesh),
+            workload.make_trainer(member_chunk=member_chunk, mesh=mesh),
+            workload.default_space(),
+            *arrays,
         )
     return workload._fused_cache[1:]
 

@@ -87,7 +87,7 @@ def fused_sha(
     snapshot — produces the IDENTICAL result of an uninterrupted run.
     A config-mismatched checkpoint raises ValueError.
     """
-    from mpi_opt_tpu.parallel.mesh import pop_sharding, replicate, shard_popstate
+    from mpi_opt_tpu.parallel.mesh import place_pop, shard_popstate
 
     trainer, space, train_x, train_y, val_x, val_y = workload_arrays(
         workload, member_chunk, mesh
@@ -137,11 +137,9 @@ def fused_sha(
         unit = space.sample_unit(k_unit, n_trials)
         state = trainer.init_population(k_init, train_x[:2], n_trials)
     if mesh is not None:
+        # datasets were already replicated over the mesh by workload_arrays
         state = shard_popstate(state, mesh)
-        unit = jax.device_put(unit, pop_sharding(mesh))
-        rep = replicate(mesh)
-        train_x, train_y = jax.device_put(train_x, rep), jax.device_put(train_y, rep)
-        val_x, val_y = jax.device_put(val_x, rep), jax.device_put(val_y, rep)
+        unit = place_pop(unit, mesh)
 
     try:
         for r in range(start_rung, len(rungs)):
@@ -163,7 +161,7 @@ def fused_sha(
                 if mesh is not None:
                     # re-place: the gather may leave survivors unsharded/skewed
                     state = shard_popstate(state, mesh)
-                    unit = jax.device_put(unit, pop_sharding(mesh))
+                    unit = place_pop(unit, mesh)
                 alive = alive[np.asarray(keep)]
                 # post-cut survivors' scores, for a resume-at-complete result
                 np_scores = np.asarray(scores)[np.asarray(keep)]

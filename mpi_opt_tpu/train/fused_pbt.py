@@ -135,7 +135,7 @@ def fused_pbt(
     """
     import numpy as np
 
-    from mpi_opt_tpu.parallel.mesh import replicate, shard_popstate
+    from mpi_opt_tpu.parallel.mesh import shard_popstate
     from mpi_opt_tpu.train.common import workload_arrays
 
     if generations < 1:  # before any data/device work
@@ -192,13 +192,11 @@ def fused_pbt(
         unit = space.sample_unit(k_unit, population)
         state = trainer.init_population(k_init, train_x[:2], population)
     if mesh is not None:
-        from mpi_opt_tpu.parallel.mesh import pop_sharding
+        from mpi_opt_tpu.parallel.mesh import place_pop
 
+        # datasets were already replicated over the mesh by workload_arrays
         state = shard_popstate(state, mesh)
-        unit = jax.device_put(unit, pop_sharding(mesh))
-        rep = replicate(mesh)
-        train_x, train_y = jax.device_put(train_x, rep), jax.device_put(train_y, rep)
-        val_x, val_y = jax.device_put(val_x, rep), jax.device_put(val_y, rep)
+        unit = place_pop(unit, mesh)
 
     # hparams_fn must be hashable-static; space comes from the per-
     # workload cache above so its identity is stable across calls

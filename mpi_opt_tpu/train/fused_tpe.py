@@ -52,9 +52,17 @@ def tpe_generation(
 ):
     """One fused generation. Returns (obs_unit, obs_scores, valid,
     key', gen_scores[n_suggest], gen_units[n_suggest, d])."""
+    from mpi_opt_tpu.parallel.mesh import constrain_pop
+
     key, k_sug, k_init, k_train = jax.random.split(key, 4)
     sugg, _ = tpe_suggest(k_sug, obs_unit, obs_scores, valid, n_suggest, cfg)
-    state = trainer.init_population(k_init, train_x[:2], n_suggest)
+    # the generation's cohort is born inside this program: constrain it
+    # over 'pop' so training shards instead of inheriting the (replicated)
+    # buffer layout. trainer.mesh is static, so this traces to a no-op
+    # without a mesh.
+    state = constrain_pop(
+        trainer.init_population(k_init, train_x[:2], n_suggest), trainer.mesh
+    )
     hp = hparams_fn(sugg)
     state, _ = trainer.train_segment(state, hp, train_x, train_y, k_train, budget)
     scores = trainer.eval_population(state, val_x, val_y)
@@ -74,6 +82,7 @@ def fused_tpe(
     seed: int = 0,
     cfg: TPEConfig = TPEConfig(),
     member_chunk: int = 0,
+    mesh=None,
     checkpoint_dir: str = None,
 ):
     """Run an n_trials TPE sweep as ceil(n_trials/batch) fused
@@ -84,11 +93,18 @@ def fused_tpe(
     crash-recoverable at generation granularity; the RNG key snapshots
     with the buffer, so a resumed sweep finishes with the IDENTICAL
     result of an uninterrupted one (tested).
+
+    ``mesh``: optional ``('pop','data')`` mesh. The observation buffer
+    (tiny) replicates; each generation's cohort trains sharded over
+    'pop' (constraint applied inside ``tpe_generation``) with the batch
+    data-parallel over 'data' — the suggest step reads the replicated
+    buffer identically on every device, so no collective is needed
+    beyond what the partitioner inserts for training.
     """
     if n_trials < 1:
         raise ValueError(f"n_trials must be >= 1, got {n_trials}")
     trainer, space, train_x, train_y, val_x, val_y = workload_arrays(
-        workload, member_chunk, None
+        workload, member_chunk, mesh
     )
     d = len(space.discrete_mask())
     sizes = [batch] * (n_trials // batch)
@@ -100,6 +116,15 @@ def fused_tpe(
     obs_unit = jnp.zeros((M, d), jnp.float32)
     obs_scores = jnp.zeros((M,), jnp.float32)
     valid = jnp.zeros((M,), bool)
+    if mesh is not None:
+        from mpi_opt_tpu.parallel.mesh import replicate
+
+        rep = replicate(mesh)
+        obs_unit, obs_scores, valid = (
+            jax.device_put(obs_unit, rep),
+            jax.device_put(obs_scores, rep),
+            jax.device_put(valid, rep),
+        )
     from mpi_opt_tpu.train.common import HParamsFn
 
     hparams_fn = HParamsFn(space, workload)
@@ -134,6 +159,12 @@ def fused_tpe(
             obs_unit = jnp.asarray(sweep["obs_unit"])
             obs_scores = jnp.asarray(sweep["obs_scores"])
             valid = jnp.asarray(sweep["valid"])
+            if mesh is not None:
+                obs_unit, obs_scores, valid = (
+                    jax.device_put(obs_unit, rep),
+                    jax.device_put(obs_scores, rep),
+                    jax.device_put(valid, rep),
+                )
             key = jax.random.wrap_key_data(jnp.asarray(sweep["key_data"]))
             start_gen = int(meta["gens_done"])
             done = sum(sizes[:start_gen])

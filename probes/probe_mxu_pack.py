@@ -1,0 +1,102 @@
+"""Does packing population members into MXU lanes help? (VERDICT r3 item 4)
+
+The population conv is block-diagonal as a bilinear form: member m's
+output needs member m's activations AND member m's weights, so any
+dense-matmul packing of k members into the 128-lane dimension must
+either (a) replicate the K (reduction) dimension k-fold with a
+block-diagonal weight matrix — doing k x the FLOPs — or (b) give each
+member its own matmul with N = Cout lanes. There is no formulation
+where k members share one LHS: the lane fill gained is exactly paid
+back in wasted MACs. This probe measures that equivalence on the real
+chip rather than asserting it:
+
+  t_single   : [M, 288] @ [288, 32]    — one member's conv-as-matmul
+               (Cout=32 fills 32/128 lanes; the production economics)
+  t_packed   : [M, 1152] @ [1152, 128] — 4 members block-diag packed
+               (full lanes, 4x K; one packed step does 4 members' work)
+  t_ideal    : [M, 288] @ [288, 128]   — the impossible target: full
+               lanes WITHOUT the K replication (what packing would
+               need to cost to be a win)
+
+Refutation criterion: if t_packed >= ~4 x t_single (same useful-FLOP
+rate), lane packing cannot beat per-member matmuls, and the XLA
+dilated-conv lowering (measured on par with grouped conv and 9x better
+than materialized im2col — probes/probe_conv2.py, probe_conv3.py) is
+already at the structural limit for Cout=32 convs.
+
+Run from /root/repo: python probes/probe_mxu_pack.py
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(fn, *args, iters=30):
+    """Median wall of fn(*args) with a host-fetch barrier (PERF_NOTES:
+    block_until_ready does not reliably block under the axon plugin)."""
+    out = fn(*args)
+    np.asarray(jax.tree.leaves(out)[0][0, 0])  # warm + barrier
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        np.asarray(jax.tree.leaves(out)[0][0, 0])
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def chain(k, n, reps=16):
+    """A jitted chain of `reps` independent [M,k]@[k,n] matmuls so the
+    per-dispatch overhead (~3-5 ms, PERF_NOTES) is amortized."""
+    M = 8192
+    key = jax.random.key(0)
+    a = jax.random.normal(key, (reps, M, k), jnp.bfloat16)
+    b = jax.random.normal(key, (reps, k, n), jnp.bfloat16) * 0.01
+
+    @jax.jit
+    def step(a, b):
+        # independent matmuls (not a chain through one buffer): mirrors
+        # the per-layer convs of independent members
+        return jnp.einsum("rmk,rkn->rmn", a, b)
+
+    t = timed(step, a, b)
+    useful = 2 * reps * M * k * n
+    return t, useful
+
+
+def main():
+    print(f"device: {jax.devices()[0].device_kind}", flush=True)
+    t_single, f_single = chain(288, 32)
+    t_packed, f_packed = chain(1152, 128)  # 4-member block-diag: useful FLOPs = f/4
+    t_ideal, f_ideal = chain(288, 128)
+
+    # per-member-conv cost under each scheme
+    per_single = t_single  # 16 convs of 1 member each -> 16 member-convs
+    per_packed = t_packed / 4  # each packed matmul does 4 members
+    rate = lambda f, t: f / t / 1e12
+    print(
+        f"single (N=32, 25% lanes): {t_single*1e3:8.2f} ms "
+        f"{rate(f_single, t_single):6.1f} TF/s useful"
+    )
+    print(
+        f"packed (N=128, 4x K):     {t_packed*1e3:8.2f} ms "
+        f"{rate(f_packed/4, t_packed):6.1f} TF/s useful "
+        f"({rate(f_packed, t_packed):5.1f} raw)"
+    )
+    print(
+        f"ideal  (N=128, 1x K):     {t_ideal*1e3:8.2f} ms "
+        f"{rate(f_ideal, t_ideal):6.1f} TF/s useful (unreachable bound)"
+    )
+    ratio = per_packed / per_single
+    print(f"\npacked/single cost per member-conv: {ratio:.2f}x "
+          f"({'packing LOSES' if ratio > 0.95 else 'packing WINS'})")
+
+
+if __name__ == "__main__":
+    main()

@@ -139,3 +139,26 @@ def test_full_search_asha_on_tpu_backend(workload):
     res = run_search(algo, be)
     assert res.n_trials == 12
     assert res.best.score > 0.3
+
+
+def test_meshed_slot_pool_shards_and_matches_unmeshed(workload):
+    """A mesh-aware slot pool (driver path, VERDICT r2 item 1) keeps the
+    pool sharded over 'pop' across evaluate() scatters, and scores agree
+    with the single-device pool (sharding is a layout, not semantics)."""
+    import jax
+
+    from mpi_opt_tpu.parallel import make_mesh
+
+    mesh = make_mesh(n_pop=8, n_data=1)
+    space = workload.default_space()
+    trials = [_trial(space, 100 + i, budget=10, seed=i) for i in range(8)]
+    be_mesh = get_backend("tpu", workload, population=8, seed=5, mesh=mesh)
+    r_mesh = be_mesh.evaluate(trials)
+    for leaf in jax.tree.leaves(be_mesh._pool.params):
+        assert len(leaf.devices()) == 8, leaf.sharding
+        assert not leaf.sharding.is_fully_replicated
+    be_plain = get_backend("tpu", workload, population=8, seed=5)
+    r_plain = be_plain.evaluate(trials)
+    for m, p in zip(r_mesh, r_plain):
+        assert m.trial_id == p.trial_id
+        assert m.score == pytest.approx(p.score, abs=0.02)

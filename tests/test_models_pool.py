@@ -1,0 +1,29 @@
+"""max_pool_2x2: forward-exact vs nn.max_pool, elementwise-VJP backward."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_opt_tpu.models.cnn import max_pool_2x2
+
+
+def test_forward_matches_nn_max_pool():
+    x = jax.random.normal(jax.random.key(0), (4, 8, 8, 16), jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(max_pool_2x2(x)),
+        np.asarray(nn.max_pool(x, (2, 2), strides=(2, 2))),
+    )
+
+
+def test_backward_is_valid_subgradient_without_select_and_scatter():
+    x = jax.random.normal(jax.random.key(1), (2, 4, 4, 3), jnp.float32)
+    g = jax.grad(lambda a: jnp.sum(max_pool_2x2(a)))(x)
+    # each window's cotangent (1.0) lands entirely on that window's max
+    # (no ties in random normal input), all other positions get zero
+    gw = np.asarray(g).reshape(2, 2, 2, 2, 2, 3)
+    np.testing.assert_allclose(gw.sum(axis=(2, 4)), 1.0, rtol=1e-6)
+    assert ((np.asarray(g) == 0).sum()) == g.size - 2 * 2 * 2 * 3
+    # and the lowered backward program contains no select-and-scatter
+    txt = jax.jit(jax.grad(lambda a: jnp.sum(max_pool_2x2(a)))).lower(x).as_text()
+    assert "select_and_scatter" not in txt and "select-and-scatter" not in txt

@@ -1,0 +1,76 @@
+"""Multi-process bring-up SUCCESS path (SURVEY.md §5 "multi-host").
+
+``TestInitializeMultihost`` (test_parallel.py) pins the failure paths —
+this file proves the success path this container CAN run: two real OS
+processes (the stand-in for two TPU hosts), a localhost coordinator,
+``initialize_multihost`` in each, a global ('pop','data') mesh spanning
+both processes' devices, and a cross-process reduction whose result
+agrees in both processes (gloo CPU collectives; on TPU hardware the
+identical code rides ICI/DCN).
+
+Subprocesses are unavoidable here: jax.distributed must initialize
+before the XLA backend exists, and the pytest process's backend is
+already up (and pinned to 8 virtual devices).
+"""
+
+import socket
+import subprocess
+import sys
+
+_WORKER = r"""
+import sys
+
+import jax
+
+# per-process platform pinning must happen BEFORE initialize_multihost
+# (the axon sitecustomize pins JAX_PLATFORMS; config overrides it)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+from mpi_opt_tpu.parallel.mesh import make_mesh, initialize_multihost
+
+pid, port = int(sys.argv[1]), sys.argv[2]
+idx = initialize_multihost(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+)
+assert idx == pid, (idx, pid)
+assert jax.process_count() == 2, jax.process_count()
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# the global mesh spans BOTH processes' devices (4 = 2 procs x 2 local)
+mesh = make_mesh(n_pop=2, n_data=2)
+assert mesh.devices.size == 4
+assert len(set(d.process_index for d in mesh.devices.flat)) == 2
+
+x = jax.device_put(jnp.arange(8.0), NamedSharding(mesh, P(("pop", "data"))))
+total = jax.jit(lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P()))(x)
+val = float(total.addressable_shards[0].data)
+assert val == 28.0, val
+print(f"RESULT {pid} {val}", flush=True)
+"""
+
+
+def test_two_process_bringup_and_global_psum():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(pid), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd="/root/repo",
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        outs.append(out)
+    for pid, out in enumerate(outs):
+        assert f"RESULT {pid} 28.0" in out, out

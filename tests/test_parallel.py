@@ -135,3 +135,44 @@ class TestInitializeMultihost:
         # explicit multi-process request: must raise, not shrink
         with pytest.raises(RuntimeError):
             initialize_multihost(num_processes=2)
+
+
+def test_fused_pbt_final_state_sharded(workload):
+    """The fused sweep's carried population must END sharded over 'pop'
+    — if any launch-boundary op (exploit gather, snapshot round-trip)
+    dropped the placement, multi-chip sweeps would silently degrade to
+    replicated execution."""
+    mesh = make_mesh(n_pop=8, n_data=1)
+    r = fused_pbt(workload, population=8, generations=2, steps_per_gen=5, seed=1, mesh=mesh)
+    leaves = jax.tree.leaves(r["state"].params)
+    assert leaves, "fused_pbt result carries no state"
+    for leaf in leaves:
+        assert len(leaf.devices()) == 8, leaf.sharding
+        assert not leaf.sharding.is_fully_replicated
+
+
+def test_fused_tpe_sharded_matches_unsharded(workload):
+    """Fused TPE over a mesh (incl. a tail generation that does not
+    divide the 'pop' axis) must match the single-device trajectory."""
+    from mpi_opt_tpu.train.fused_tpe import fused_tpe
+
+    kw = dict(n_trials=12, batch=8, budget=5, seed=4)
+    r1 = fused_tpe(workload, **kw)
+    mesh = make_mesh(n_pop=8, n_data=1)
+    r2 = fused_tpe(workload, mesh=mesh, **kw)
+    assert r2["best_score"] == pytest.approx(r1["best_score"], abs=0.02)
+    np.testing.assert_allclose(r2["best_curve"], r1["best_curve"], atol=0.02)
+
+
+def test_fused_sha_sharded_rounds_survivors_to_pop_axis(workload):
+    """On a mesh, rung survivor counts round UP to the 'pop' axis so
+    cohorts stay shardable; a 16-trial eta-4 sweep on an 8-way mesh
+    keeps 8 (not 4) survivors."""
+    from mpi_opt_tpu.train.fused_asha import fused_sha
+
+    mesh = make_mesh(n_pop=8, n_data=1)
+    r = fused_sha(
+        workload, n_trials=16, min_budget=5, max_budget=20, eta=4, seed=2, mesh=mesh
+    )
+    assert r["rung_sizes"] == [16, 8]
+    assert 0.0 <= r["best_score"] <= 1.0
