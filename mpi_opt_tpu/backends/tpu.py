@@ -310,6 +310,20 @@ class TPUPopulationBackend(Backend):
                 f"restored pool structure {got} does not match this "
                 f"backend's pool {want} (different workload/population?)"
             )
+        # treedefs ignore leaf shapes: a pool checkpointed under a
+        # different mesh/pool_size (pool_size rounds to the 'pop' axis)
+        # has the same structure but different slot counts — installing
+        # it would let the scratch slot collide with a live slot and
+        # silently corrupt members on every padded scatter
+        got_shapes = [tuple(x.shape) for x in jax.tree.leaves(pool)]
+        want_shapes = [tuple(x.shape) for x in jax.tree.leaves(self._pool)]
+        if got_shapes != want_shapes:
+            raise ValueError(
+                "restored pool leaf shapes do not match this backend's "
+                f"pool (saved slot count {got_shapes[0][0]}, this backend "
+                f"{want_shapes[0][0]} — resumed under a different mesh or "
+                "population?)"
+            )
         # free the freshly-initialized pool BEFORE uploading the restored
         # one: a ResNet-scale pool cannot afford 2x residency
         self._pool = None

@@ -213,10 +213,13 @@ def run_fused(args, parser, workload) -> int:
 
     mesh = build_mesh(args)
     # per-chip accounting divides by the devices the sweep ACTUALLY runs
-    # on: the mesh's devices when sharded, exactly 1 otherwise (dividing
-    # by local_device_count would understate per-chip throughput on a
-    # multi-chip host running --no-mesh; ADVICE round 2)
-    n_chips = mesh.devices.size if mesh is not None else 1
+    # on: THIS process's share of the mesh when sharded (each host's CLI
+    # counts only its own trials — global size would understate by the
+    # host count), exactly 1 otherwise (local_device_count would
+    # understate on a multi-chip host running --no-mesh; ADVICE round 2)
+    from mpi_opt_tpu.parallel.mesh import local_mesh_device_count
+
+    n_chips = local_mesh_device_count(mesh) if mesh is not None else 1
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
     t0 = time.perf_counter()
     with profile_window(args.profile_dir):
@@ -328,13 +331,15 @@ def main(argv=None) -> int:
     # the metric of record is trials/sec/CHIP; normalizing by 1 on a
     # multi-chip TPU run would overstate it by the chip count, and by
     # the device count on a --no-mesh run that only uses one device —
-    # so count the devices the slot pool is actually sharded over.
-    # Local devices, not global: each host's driver counts only its own
-    # trials, so dividing by the global count would understate per-chip
-    # throughput by the host count.
+    # so count THIS process's share of the devices the slot pool is
+    # actually sharded over. Local, not global: each host's driver
+    # counts only its own trials, so dividing by the global count would
+    # understate per-chip throughput by the host count.
     n_chips = 1
     if args.backend == "tpu" and mesh is not None:
-        n_chips = mesh.devices.size
+        from mpi_opt_tpu.parallel.mesh import local_mesh_device_count
+
+        n_chips = local_mesh_device_count(mesh)
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
     checkpointer = None
     if args.checkpoint_dir:

@@ -6,31 +6,6 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
-def max_pool_2x2(x: jnp.ndarray) -> jnp.ndarray:
-    """Non-overlapping 2x2/stride-2 max pool as reshape + reduce-max.
-
-    Forward-identical to ``nn.max_pool(x, (2,2), strides=(2,2))`` (the
-    windows don't overlap, so both are an exact max over the same
-    disjoint 2x2 blocks), but the VJP is an elementwise equality mask
-    instead of TPU's ``select-and-scatter`` — which a profiler trace of
-    the population sweep measured at 8% of device time (PERF_NOTES.md
-    "Trace-level breakdown"). The only numerical difference is tie
-    handling in the gradient: reduce-max splits the cotangent evenly
-    among tied window elements (common post-relu, where whole windows
-    are exactly 0) where select-and-scatter sends it all to the first —
-    both are valid subgradients.
-    """
-    b, h, w, c = x.shape
-    if h % 2 or w % 2:
-        raise ValueError(
-            f"max_pool_2x2 needs even spatial dims, got {h}x{w} "
-            "(nn.max_pool floors the window count; this exact-reshape "
-            "variant deliberately does not)"
-        )
-    x = x.reshape(b, h // 2, 2, w // 2, 2, c)
-    return x.max(axis=(2, 4))
-
-
 class SmallCNN(nn.Module):
     """conv32-conv32-pool-conv64-conv64-pool-dense128-dense.
 
@@ -51,7 +26,15 @@ class SmallCNN(nn.Module):
             x = nn.GroupNorm(num_groups=8, dtype=self.dtype, name=f"gn{i}")(x)
             x = nn.relu(x)
             if i % 2 == 1:
-                x = max_pool_2x2(x)
+                # nn.max_pool (select-and-scatter backward, ~8% of device
+                # time) was A/B'd against a reshape+reduce-max variant
+                # whose VJP is an elementwise tie-splitting mask: the
+                # variant measured SLOWER (17.7 vs 15.6 s, pop=64 x 2
+                # gens on the real chip) and learned far worse (best
+                # 0.211 vs 0.548 at gen 2, seed 0) — bf16 ties make the
+                # split gradient materially different. Refutation probe:
+                # probes/probe_pool_ab.py; PERF_NOTES.md "Pooling".
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(4 * w, dtype=self.dtype, name="fc1")(x)
         x = nn.relu(x)

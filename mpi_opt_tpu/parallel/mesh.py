@@ -76,11 +76,19 @@ def shard_popstate(state: Any, mesh: Mesh) -> Any:
     member-parallel — this happens for e.g. an SHA first cohort of 9
     trials on an 8-way mesh, whose later (rounded) rungs shard fully.
     """
-    n_pop = mesh.shape["pop"]
-    sh, rep = pop_sharding(mesh), replicate(mesh)
-    return jax.tree.map(
-        lambda x: jax.device_put(x, sh if x.shape[0] % n_pop == 0 else rep), state
-    )
+    return jax.tree.map(lambda x: place_pop(x, mesh), state)
+
+
+def local_mesh_device_count(mesh: Mesh) -> int:
+    """How many of this mesh's devices belong to THIS process.
+
+    The per-chip metric divisor: each host's driver counts only its own
+    trials, so on a multi-host mesh it must divide by its own share of
+    the devices — ``mesh.devices.size`` would understate per-chip
+    throughput by the host count.
+    """
+    me = jax.process_index()
+    return sum(1 for d in mesh.devices.flat if d.process_index == me)
 
 
 def place_pop(x: jax.Array, mesh: Mesh) -> jax.Array:

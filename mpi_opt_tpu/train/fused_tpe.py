@@ -112,19 +112,21 @@ def fused_tpe(
         sizes.append(n_trials % batch)
     M = n_trials  # buffer exactly fits the sweep
 
-    key = jax.random.key(seed)
-    obs_unit = jnp.zeros((M, d), jnp.float32)
-    obs_scores = jnp.zeros((M,), jnp.float32)
-    valid = jnp.zeros((M,), bool)
-    if mesh is not None:
+    def place_buffers(obs_unit, obs_scores, valid):
+        """The obs buffer replicates over the mesh (single placement
+        point for both the fresh-init and checkpoint-restore paths)."""
+        if mesh is None:
+            return obs_unit, obs_scores, valid
         from mpi_opt_tpu.parallel.mesh import replicate
 
         rep = replicate(mesh)
-        obs_unit, obs_scores, valid = (
-            jax.device_put(obs_unit, rep),
-            jax.device_put(obs_scores, rep),
-            jax.device_put(valid, rep),
-        )
+        return tuple(jax.device_put(a, rep) for a in (obs_unit, obs_scores, valid))
+
+    key = jax.random.key(seed)
+    obs_unit, obs_scores, valid = place_buffers(
+        jnp.zeros((M, d), jnp.float32), jnp.zeros((M,), jnp.float32),
+        jnp.zeros((M,), bool),
+    )
     from mpi_opt_tpu.train.common import HParamsFn
 
     hparams_fn = HParamsFn(space, workload)
@@ -156,15 +158,11 @@ def fused_tpe(
         restored = snap.restore()
         if restored is not None:
             sweep, meta = restored
-            obs_unit = jnp.asarray(sweep["obs_unit"])
-            obs_scores = jnp.asarray(sweep["obs_scores"])
-            valid = jnp.asarray(sweep["valid"])
-            if mesh is not None:
-                obs_unit, obs_scores, valid = (
-                    jax.device_put(obs_unit, rep),
-                    jax.device_put(obs_scores, rep),
-                    jax.device_put(valid, rep),
-                )
+            obs_unit, obs_scores, valid = place_buffers(
+                jnp.asarray(sweep["obs_unit"]),
+                jnp.asarray(sweep["obs_scores"]),
+                jnp.asarray(sweep["valid"]),
+            )
             key = jax.random.wrap_key_data(jnp.asarray(sweep["key_data"]))
             start_gen = int(meta["gens_done"])
             done = sum(sizes[:start_gen])

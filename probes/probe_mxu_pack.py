@@ -4,25 +4,31 @@ The population conv is block-diagonal as a bilinear form: member m's
 output needs member m's activations AND member m's weights, so any
 dense-matmul packing of k members into the 128-lane dimension must
 either (a) replicate the K (reduction) dimension k-fold with a
-block-diagonal weight matrix — doing k x the FLOPs — or (b) give each
+block-diagonal weight matrix — doing k x the MACs — or (b) give each
 member its own matmul with N = Cout lanes. There is no formulation
 where k members share one LHS: the lane fill gained is exactly paid
 back in wasted MACs. This probe measures that equivalence on the real
-chip rather than asserting it:
+chip rather than asserting it.
 
-  t_single   : [M, 288] @ [288, 32]    — one member's conv-as-matmul
-               (Cout=32 fills 32/128 lanes; the production economics)
-  t_packed   : [M, 1152] @ [1152, 128] — 4 members block-diag packed
-               (full lanes, 4x K; one packed step does 4 members' work)
-  t_ideal    : [M, 288] @ [288, 128]   — the impossible target: full
-               lanes WITHOUT the K replication (what packing would
-               need to cost to be a win)
+Measured 2026-07-30 (this container's tunneled v5e):
 
-Refutation criterion: if t_packed >= ~4 x t_single (same useful-FLOP
-rate), lane packing cannot beat per-member matmuls, and the XLA
-dilated-conv lowering (measured on par with grouped conv and 9x better
-than materialized im2col — probes/probe_conv2.py, probe_conv3.py) is
-already at the structural limit for Cout=32 convs.
+    single member   [8192,288]@[288,32]    : 14.3 TF/s useful
+    4-pack blockdiag [8192,1152]@[1152,128]: 57.1 raw = 14.3 TF/s useful
+    same-K full-lane [8192,288]@[288,128]  : 23.9 TF/s (unreachable bound)
+    cap             4096^3                 : 157  TF/s
+
+packed == single to three digits -> packing refuted; see PERF_NOTES.md
+"Round 3 — MXU member-packing refuted by measurement". The same run
+exposed that the round-2 platform-cap probe underread the machine 2.4x
+(64.8 vs 157 TF/s) — bench.py's measure_platform_cap now uses this
+harness's pattern.
+
+Harness notes (both matter, both measured today):
+- the tunnel's per-FETCH round trip is 20-90 ms; loop the work inside
+  one program behind a scalar serial dependency and fetch once;
+- `x = a + s` (s the carried scalar) defeats loop-invariant hoisting
+  without serializing through the full result matrix the way round 2's
+  `b = (a @ b) * 1e-3` chain did.
 
 Run from /root/repo: python probes/probe_mxu_pack.py
 """
@@ -37,65 +43,42 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def timed(fn, *args, iters=30):
-    """Median wall of fn(*args) with a host-fetch barrier (PERF_NOTES:
-    block_until_ready does not reliably block under the axon plugin)."""
-    out = fn(*args)
-    np.asarray(jax.tree.leaves(out)[0][0, 0])  # warm + barrier
-    ts = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        out = fn(*args)
-        np.asarray(jax.tree.leaves(out)[0][0, 0])
-        ts.append(time.perf_counter() - t0)
-    return float(np.median(ts))
-
-
-def chain(k, n, reps=16):
-    """A jitted chain of `reps` independent [M,k]@[k,n] matmuls so the
-    per-dispatch overhead (~3-5 ms, PERF_NOTES) is amortized."""
-    M = 8192
-    key = jax.random.key(0)
-    a = jax.random.normal(key, (reps, M, k), jnp.bfloat16)
-    b = jax.random.normal(key, (reps, k, n), jnp.bfloat16) * 0.01
+def rate(M, K, N, loops, iters=4):
+    """TF/s of [M,K]@[K,N] bf16 matmuls, `loops` per program, one fetch."""
+    a = jax.random.normal(jax.random.key(0), (M, K), jnp.bfloat16)
+    b = jax.random.normal(jax.random.key(1), (K, N), jnp.bfloat16) * 0.01
 
     @jax.jit
     def step(a, b):
-        # independent matmuls (not a chain through one buffer): mirrors
-        # the per-layer convs of independent members
-        return jnp.einsum("rmk,rkn->rmn", a, b)
+        def body(i, s):
+            x = a + s
+            y = x @ b
+            return jnp.sum(y).astype(jnp.bfloat16) * jnp.bfloat16(1e-9)
 
-    t = timed(step, a, b)
-    useful = 2 * reps * M * k * n
-    return t, useful
+        return jax.lax.fori_loop(0, loops, body, jnp.bfloat16(0))
+
+    float(step(a, b))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        s = step(a, b)
+    float(s)
+    t = (time.perf_counter() - t0) / iters
+    return 2 * M * K * N * loops / t / 1e12
 
 
 def main():
     print(f"device: {jax.devices()[0].device_kind}", flush=True)
-    t_single, f_single = chain(288, 32)
-    t_packed, f_packed = chain(1152, 128)  # 4-member block-diag: useful FLOPs = f/4
-    t_ideal, f_ideal = chain(288, 128)
-
-    # per-member-conv cost under each scheme
-    per_single = t_single  # 16 convs of 1 member each -> 16 member-convs
-    per_packed = t_packed / 4  # each packed matmul does 4 members
-    rate = lambda f, t: f / t / 1e12
-    print(
-        f"single (N=32, 25% lanes): {t_single*1e3:8.2f} ms "
-        f"{rate(f_single, t_single):6.1f} TF/s useful"
-    )
-    print(
-        f"packed (N=128, 4x K):     {t_packed*1e3:8.2f} ms "
-        f"{rate(f_packed/4, t_packed):6.1f} TF/s useful "
-        f"({rate(f_packed, t_packed):5.1f} raw)"
-    )
-    print(
-        f"ideal  (N=128, 1x K):     {t_ideal*1e3:8.2f} ms "
-        f"{rate(f_ideal, t_ideal):6.1f} TF/s useful (unreachable bound)"
-    )
-    ratio = per_packed / per_single
-    print(f"\npacked/single cost per member-conv: {ratio:.2f}x "
-          f"({'packing LOSES' if ratio > 0.95 else 'packing WINS'})")
+    r_single = rate(8192, 288, 32, 8000)
+    r_packed = rate(8192, 1152, 128, 2000)  # one packed matmul = 4 members
+    r_ideal = rate(8192, 288, 128, 2000)
+    r_cap = rate(4096, 4096, 4096, 200)
+    print(f"single (N=32, 25% lanes):     {r_single:6.1f} TF/s useful")
+    print(f"packed (N=128, 4x K): raw     {r_packed:6.1f} -> useful {r_packed/4:6.1f} TF/s")
+    print(f"ideal  (N=128, 1x K, bound):  {r_ideal:6.1f} TF/s")
+    print(f"cap    (4096^3):              {r_cap:6.1f} TF/s")
+    win = r_packed / 4 / r_single
+    print(f"\npacked/single useful rate: {win:.2f}x "
+          f"({'packing WINS' if win > 1.05 else 'packing refuted'})")
 
 
 if __name__ == "__main__":
