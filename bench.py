@@ -102,11 +102,13 @@ def bench_tpu(args):
         wl, population, generations, steps, n_evals=generations
     )
 
-    # wall-clock to target val-acc (metric of record #2)
-    from mpi_opt_tpu.utils.metrics import wall_to_target as _wtt
+    # wall-clock to target val-acc (metric of record #2): launch-granular
+    # — launch boundaries use their measured durations, only generations
+    # inside one launch are prorated (utils.metrics)
+    from mpi_opt_tpu.utils.metrics import sweep_wall_to_target as _wtt
 
     curve = [float(v) for v in result["best_curve"]]
-    wall_to_target = _wtt(curve, wall, args.target_acc)
+    wall_to_target = _wtt(result, wall, args.target_acc)
 
     util = mfu(flops, wall, jax.devices()[0])
     cap_tf = measure_platform_cap() if jax.default_backend() == "tpu" else None

@@ -61,6 +61,19 @@ class Backend(abc.ABC):
     def close(self) -> None:
         pass
 
+    def reset(self) -> None:
+        """Forget all per-search state, making the backend equivalent to a
+        freshly constructed one (minus re-paying device setup/compiles).
+
+        A backend serves ONE search at a time: trial ids are allocated
+        per-algorithm starting at 0, so running a second search against a
+        used backend makes the new ids collide with the old ledger — a
+        stateful backend would silently treat fresh trials as warm
+        resumes of the previous search's state. Call ``reset()`` between
+        independent searches that share a backend (e.g. a warmup search
+        before a timed one). Stateless backends need nothing.
+        """
+
     # -- checkpoint/resume (utils/checkpoint.py) -------------------------
     #
     # Backends without device-resident state use the defaults: losing a

@@ -213,6 +213,13 @@ class CPUBackend(Backend):
             wall_time=time.perf_counter() - t0,
         )
 
+    def reset(self):
+        """Drop the stateful-path state store (see Backend.reset): a new
+        search's trial ids must not warm-resume the previous search's
+        states. The worker pool (process spawns) is kept."""
+        self._states.clear()
+        self._trained.clear()
+
     def close(self):
         if self._pool is not None:
             self._pool.terminate()

@@ -263,6 +263,23 @@ class TPUPopulationBackend(Backend):
     def close(self):
         pass
 
+    def reset(self):
+        """Per-search state back to construction time, pool buffers kept.
+
+        Every post-reset trial resolves as fresh (the ledger is empty),
+        so stale pool contents are unreachable except through the
+        scratch slot, which is never read as a real member; resetting
+        ``_step_counter`` restores the RNG stream, so a reset backend
+        produces BIT-IDENTICAL results to a newly constructed one
+        (tested) while keeping the device pool and compiled programs.
+        """
+        if not self._setup_done:
+            return
+        self._slot_of.clear()
+        self._trained.clear()
+        self._free = [s for s in range(self.pool_size) if s != self._scratch]
+        self._step_counter = 0
+
     # -- checkpoint/resume ------------------------------------------------
     #
     # The slot pool is the expensive thing to lose: every live trial's
@@ -323,6 +340,15 @@ class TPUPopulationBackend(Backend):
                 f"pool (saved slot count {got_shapes[0][0]}, this backend "
                 f"{want_shapes[0][0]} — resumed under a different mesh or "
                 "population?)"
+            )
+        got_dtypes = [x.dtype for x in jax.tree.leaves(pool)]
+        want_dtypes = [x.dtype for x in jax.tree.leaves(self._pool)]
+        if got_dtypes != want_dtypes:
+            raise ValueError(
+                "restored pool leaf dtypes do not match this backend's pool "
+                "(saved under a different momentum storage dtype? see "
+                "MPI_OPT_TPU_MOMENTUM_DTYPE) — refusing rather than feeding "
+                "mismatched state into the compiled programs"
             )
         # free the freshly-initialized pool BEFORE uploading the restored
         # one: a ResNet-scale pool cannot afford 2x residency

@@ -213,13 +213,13 @@ def run_fused(args, parser, workload) -> int:
 
     mesh = build_mesh(args)
     # per-chip accounting divides by the devices the sweep ACTUALLY runs
-    # on: THIS process's share of the mesh when sharded (each host's CLI
-    # counts only its own trials — global size would understate by the
-    # host count), exactly 1 otherwise (local_device_count would
-    # understate on a multi-chip host running --no-mesh; ADVICE round 2)
-    from mpi_opt_tpu.parallel.mesh import local_mesh_device_count
-
-    n_chips = local_mesh_device_count(mesh) if mesh is not None else 1
+    # on: the mesh's GLOBAL device count when sharded, exactly 1
+    # otherwise (local_device_count would overstate the denominator on a
+    # multi-chip host running --no-mesh; ADVICE round 2). Global, not
+    # this process's share: under multi-host SPMD every process drives
+    # the same global sweep and counts the same global trial total, so a
+    # local divisor would overstate per-chip throughput by the host count.
+    n_chips = int(mesh.devices.size) if mesh is not None else 1
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
     t0 = time.perf_counter()
     with profile_window(args.profile_dir):
@@ -331,15 +331,13 @@ def main(argv=None) -> int:
     # the metric of record is trials/sec/CHIP; normalizing by 1 on a
     # multi-chip TPU run would overstate it by the chip count, and by
     # the device count on a --no-mesh run that only uses one device —
-    # so count THIS process's share of the devices the slot pool is
-    # actually sharded over. Local, not global: each host's driver
-    # counts only its own trials, so dividing by the global count would
-    # understate per-chip throughput by the host count.
+    # so count the devices the slot pool is actually sharded over: the
+    # mesh's GLOBAL size (every SPMD process drives and counts the same
+    # global batches, so a per-process share would overstate per-chip
+    # throughput by the host count).
     n_chips = 1
     if args.backend == "tpu" and mesh is not None:
-        from mpi_opt_tpu.parallel.mesh import local_mesh_device_count
-
-        n_chips = local_mesh_device_count(mesh)
+        n_chips = int(mesh.devices.size)
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
     checkpointer = None
     if args.checkpoint_dir:

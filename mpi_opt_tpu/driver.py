@@ -27,6 +27,10 @@ class SearchResult:
     n_trials: int
     wall_s: float
     trials_per_sec_per_chip: float
+    # evaluations actually run by this call: >= n_trials for multi-rung
+    # algorithms (each ASHA promotion re-enters the backend), and the
+    # numerator of trials_per_sec_per_chip
+    n_evals: int = 0
 
 
 def run_search(
@@ -79,4 +83,5 @@ def run_search(
         n_trials=algorithm.n_trials,
         wall_s=wall,
         trials_per_sec_per_chip=n_run / max(wall, 1e-9) / metrics.n_chips,
+        n_evals=n_run,
     )

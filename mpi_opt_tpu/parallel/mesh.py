@@ -79,18 +79,6 @@ def shard_popstate(state: Any, mesh: Mesh) -> Any:
     return jax.tree.map(lambda x: place_pop(x, mesh), state)
 
 
-def local_mesh_device_count(mesh: Mesh) -> int:
-    """How many of this mesh's devices belong to THIS process.
-
-    The per-chip metric divisor: each host's driver counts only its own
-    trials, so on a multi-host mesh it must divide by its own share of
-    the devices — ``mesh.devices.size`` would understate per-chip
-    throughput by the host count.
-    """
-    me = jax.process_index()
-    return sum(1 for d in mesh.devices.flat if d.process_index == me)
-
-
 def place_pop(x: jax.Array, mesh: Mesh) -> jax.Array:
     """Place one array's leading axis over ``pop`` (replicates when the
     axis does not divide — see ``shard_popstate``)."""

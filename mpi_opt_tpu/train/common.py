@@ -21,8 +21,16 @@ def workload_arrays(workload, member_chunk: int = 0, mesh=None):
     batch over 'data'). This is the single placement point for fused
     sweep data — don't re-place at call sites.
     """
+    from mpi_opt_tpu.workloads.base import resolve_momentum_dtype
+
+    # the momentum-dtype knob changes the trainer make_trainer builds;
+    # it must be part of the cache key or flipping it mid-process
+    # silently reuses the stale-dtype trainer. Resolved ONCE and passed
+    # down, so the key and the built trainer cannot disagree
+    mdt = resolve_momentum_dtype()
+    key = (member_chunk, mesh, mdt)
     cache = getattr(workload, "_fused_cache", None)
-    if cache is None or cache[0] != (member_chunk, mesh):
+    if cache is None or cache[0] != key:
         d = workload.data()
         arrays = (
             jnp.asarray(d["train_x"]),
@@ -36,12 +44,25 @@ def workload_arrays(workload, member_chunk: int = 0, mesh=None):
             rep = replicate(mesh)
             arrays = tuple(jax.device_put(a, rep) for a in arrays)
         workload._fused_cache = (
-            (member_chunk, mesh),
-            workload.make_trainer(member_chunk=member_chunk, mesh=mesh),
+            key,
+            workload.make_trainer(
+                member_chunk=member_chunk, mesh=mesh, momentum_dtype=mdt
+            ),
             workload.default_space(),
             *arrays,
         )
     return workload._fused_cache[1:]
+
+
+def momentum_dtype_str() -> str:
+    """Checkpoint-config form of the momentum storage dtype ('float32'
+    default). Part of every fused sweep's config-mismatch check: the
+    dtype is carried-state STRUCTURE — resuming a bf16-momentum snapshot
+    into an f32 trainer would crash in the scan carry (or silently
+    change numerics) instead of refusing cleanly."""
+    from mpi_opt_tpu.workloads.base import resolve_momentum_dtype
+
+    return resolve_momentum_dtype() or "float32"
 
 
 class HParamsFn:

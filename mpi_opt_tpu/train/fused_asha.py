@@ -32,7 +32,7 @@ import jax
 import numpy as np
 
 from mpi_opt_tpu.ops.asha import asha_cut, asha_rungs
-from mpi_opt_tpu.train.common import workload_arrays
+from mpi_opt_tpu.train.common import momentum_dtype_str, workload_arrays
 
 
 @functools.partial(jax.jit, static_argnames=("trainer", "eta", "k"))
@@ -124,6 +124,9 @@ def fused_sha(
                 "eta": eta,
                 "seed": seed,
                 "member_chunk": member_chunk,
+                # carried-state structure (see fused_pbt): a resumed rung
+                # must find momentum in the dtype it was saved with
+                "momentum_dtype": momentum_dtype_str(),
             },
         )
         restored = snap.restore_population_sweep()

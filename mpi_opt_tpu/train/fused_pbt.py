@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from mpi_opt_tpu.ops.pbt import PBTConfig, pbt_exploit_explore
+from mpi_opt_tpu.train.common import momentum_dtype_str
 from mpi_opt_tpu.train.population import OptHParams, PopState, PopulationTrainer
 
 
@@ -97,6 +98,7 @@ def fused_pbt(
     gen_chunk: int = 0,
     checkpoint_dir: str = None,
     snapshot_every: int = 1,
+    snapshot_last: bool = True,
 ):
     """Convenience wrapper: run a whole PBT sweep for a vision-style
     workload; optionally sharded over a ``('pop','data')`` mesh.
@@ -132,6 +134,13 @@ def fused_pbt(
     Host-fetching before the async save (rather than saving device
     buffers) is deliberate: the next launch donates the state buffers,
     which would invalidate them under orbax's background write.
+
+    ``snapshot_last=False`` skips the unconditional final-launch save.
+    The final snapshot is what makes a completed sweep re-runnable
+    without recompute (tested), but a caller that consumes the returned
+    result immediately gets nothing from it — and on this container a
+    pop=64 ResNet snapshot's host fetch costs ~6 minutes through the
+    tunnel (PERF_NOTES.md), so benches turn it off.
     """
     import numpy as np
 
@@ -161,6 +170,8 @@ def fused_pbt(
     restored = None
     start_launch = 0
     best_parts, mean_parts = [], []
+    launch_walls: list = []  # seconds per completed launch (excl. snapshot saves)
+    walls_complete = True  # False when resuming a pre-duration-recording snapshot
     scores = None
     if checkpoint_dir is not None:
         import dataclasses
@@ -180,6 +191,11 @@ def fused_pbt(
                 # PBT knobs change exploit/explore behavior: resuming under
                 # a different cfg would not be the continuation we promise
                 "cfg": dataclasses.asdict(cfg),
+                # the momentum STORAGE dtype is part of the carried state's
+                # structure: resuming a bf16-momentum snapshot into an f32
+                # trainer would crash in the scan carry (or silently change
+                # numerics) instead of refusing cleanly here
+                "momentum_dtype": momentum_dtype_str(),
             },
         )
         restored = snap.restore_population_sweep()
@@ -188,6 +204,18 @@ def fused_pbt(
             best_parts = [np.asarray(v, dtype=np.float32) for v in meta["best"]]
             mean_parts = [np.asarray(v, dtype=np.float32) for v in meta["mean"]]
             start_launch = int(meta["launches_done"])
+            # per-launch durations (not cumulative timestamps): they stay
+            # meaningful across a crash/resume, where the sweep's wall
+            # clock is discontinuous but each launch's cost is real. A
+            # snapshot from before durations were recorded has none for
+            # its completed launches; mark the set incomplete rather
+            # than inventing values (the result then reports
+            # launch_walls=None and consumers fall back to whole-sweep
+            # prorating)
+            if "launch_walls" in meta:
+                launch_walls = [float(w) for w in meta["launch_walls"]]
+            else:
+                walls_complete = False
     if restored is None:
         unit = space.sample_unit(k_unit, population)
         state = trainer.init_population(k_init, train_x[:2], population)
@@ -205,8 +233,11 @@ def fused_pbt(
     hparams_fn = HParamsFn(space, workload)
 
     snapshot_every = max(1, snapshot_every)
+    import time
+
     try:
         for i in range(start_launch, n_launches):
+            t_launch = time.perf_counter()
             # k_run is the scan-carried key returned by the previous
             # launch: the chain continues exactly as one longer scan would
             state, unit, k_run, best, mean, final_scores = run_fused_pbt(
@@ -229,14 +260,28 @@ def fused_pbt(
             best_parts.append(np.asarray(best))
             mean_parts.append(np.asarray(mean))
             scores = np.asarray(final_scores)
-            if snap is not None and ((i + 1) % snapshot_every == 0 or i + 1 == n_launches):
+            # the fetches above are the launch's completion barrier
+            # (block_until_ready is unreliable under the axon plugin —
+            # PERF_NOTES.md), so the duration is measured AFTER them and
+            # BEFORE any snapshot save
+            launch_walls.append(time.perf_counter() - t_launch)
+            is_last = i + 1 == n_launches
+            due = (i + 1) % snapshot_every == 0
+            # save when a mid-sweep save comes due, or at the final
+            # launch when the caller wants the completed-sweep snapshot
+            if snap is not None and ((due and not is_last) or (is_last and snapshot_last)):
+                meta_extra = {
+                    "launches_done": i + 1,
+                    "best": [v.tolist() for v in best_parts],
+                    "mean": [v.tolist() for v in mean_parts],
+                }
+                if walls_complete:
+                    # an incomplete set must stay absent: writing the
+                    # post-resume tail alone would misalign the NEXT
+                    # resume's restore
+                    meta_extra["launch_walls"] = [float(w) for w in launch_walls]
                 snap.save_population_sweep(
-                    i + 1, state, unit, k_run, scores,
-                    meta_extra={
-                        "launches_done": i + 1,
-                        "best": [v.tolist() for v in best_parts],
-                        "mean": [v.tolist() for v in mean_parts],
-                    },
+                    i + 1, state, unit, k_run, scores, meta_extra=meta_extra
                 )
     finally:
         if snap is not None:
@@ -251,4 +296,11 @@ def fused_pbt(
         "mean_curve": np.asarray(mean),
         "state": state,
         "unit": np.asarray(unit),
+        # measured per-launch durations + generation split, for
+        # launch-granular wall-to-target (utils.metrics); on a resumed
+        # sweep, pre-crash launches' durations come from the snapshot.
+        # None when a pre-upgrade snapshot left earlier durations
+        # unknown — callers fall back to wall_to_target
+        "launch_gens": launch_lens,
+        "launch_walls": [float(w) for w in launch_walls] if walls_complete else None,
     }

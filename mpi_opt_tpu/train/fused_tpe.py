@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpi_opt_tpu.ops.tpe import TPEConfig, tpe_suggest
-from mpi_opt_tpu.train.common import workload_arrays
+from mpi_opt_tpu.train.common import momentum_dtype_str, workload_arrays
 
 
 @functools.partial(
@@ -153,6 +153,8 @@ def fused_tpe(
                 # acquisition knobs change suggest behavior: a resumed
                 # sweep must continue under the SAME cfg
                 "cfg": dataclasses.asdict(cfg),
+                # carried-state structure (see fused_pbt)
+                "momentum_dtype": momentum_dtype_str(),
             },
         )
         restored = snap.restore()

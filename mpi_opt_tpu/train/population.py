@@ -120,6 +120,7 @@ class PopulationTrainer:
         member_chunk: int = 0,
         donate: bool = False,
         mesh=None,
+        momentum_dtype=None,
     ):
         self.apply_fn = apply_fn
         self.init_fn = init_fn
@@ -128,6 +129,12 @@ class PopulationTrainer:
         self.member_chunk = member_chunk
         self.donate = donate
         self.mesh = mesh
+        # storage dtype for the momentum buffers (None = match params,
+        # i.e. f32). The update math always runs in f32; a narrower
+        # STORAGE dtype only changes the bytes the bandwidth-bound
+        # optimizer fusions move (probes/probe_bf16_momentum.py measures
+        # whether that's a win on this platform)
+        self.momentum_dtype = momentum_dtype
         if mesh is not None and batch_size % mesh.shape["data"]:
             raise ValueError(
                 f"batch_size {batch_size} not divisible by the mesh 'data' "
@@ -148,7 +155,8 @@ class PopulationTrainer:
     def init_population(self, key: jax.Array, sample_x: jax.Array, n: int) -> PopState:
         keys = jax.random.split(key, n)
         params = jax.vmap(lambda k: self.init_fn(k, sample_x))(keys)
-        momentum = jax.tree.map(jnp.zeros_like, params)
+        dt = self.momentum_dtype
+        momentum = jax.tree.map(lambda p: jnp.zeros(p.shape, dt or p.dtype), params)
         return PopState(params=params, momentum=momentum, step=jnp.zeros((n,), jnp.int32))
 
     # -- member-level pieces (scalar hparams; vmapped below) -------------
@@ -164,12 +172,15 @@ class PopulationTrainer:
         loss, grads = jax.value_and_grad(self._member_loss)(params, hp, key, bx, by)
         # SGD + momentum + coupled L2 weight decay (wd*p folded into the
         # gradient, so the effective decay is lr-scaled), hparams as
-        # traced scalars
-        momentum = jax.tree.map(
-            lambda m, g, p: hp.momentum * m + g + hp.weight_decay * p,
+        # traced scalars. Math in f32 regardless of the momentum STORAGE
+        # dtype (the astype is a no-op at the default f32 storage).
+        m32 = jax.tree.map(
+            lambda m, g, p: hp.momentum * m.astype(jnp.float32) + g + hp.weight_decay * p,
             momentum, grads, params,
         )
-        params = jax.tree.map(lambda p, m: p - hp.lr * m, params, momentum)
+        params = jax.tree.map(lambda p, m: p - hp.lr * m, params, m32)
+        dt = self.momentum_dtype
+        momentum = m32 if dt is None else jax.tree.map(lambda m: m.astype(dt), m32)
         return params, momentum, step + 1, loss
 
     def _constrain_data(self, bx, by):

@@ -151,13 +151,20 @@ class SweepCheckpointer:
                 sweep=ocp.args.StandardRestore(), meta=ocp.args.JsonRestore()
             ),
         )
-        if r.meta["config"] != self.config:
+        saved = dict(r.meta["config"])
+        # config keys added AFTER a snapshot format existed compare
+        # against their historical default, so genuine pre-upgrade
+        # snapshots stay resumable instead of being refused for a key
+        # their writer couldn't have known about. momentum_dtype was
+        # added round 3; every earlier snapshot was written under f32.
+        saved.setdefault("momentum_dtype", "float32")
+        if saved != self.config:
             # close before raising: callers only reach their own close()
             # via try/finally blocks entered AFTER a successful restore
             self.close()
             raise ValueError(
                 "checkpoint directory holds a different sweep: "
-                f"saved config {r.meta['config']} vs requested {self.config}"
+                f"saved config {saved} vs requested {self.config}"
             )
         return r.sweep, r.meta
 

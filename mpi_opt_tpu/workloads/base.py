@@ -43,6 +43,17 @@ class Workload(abc.ABC):
         return float(score)
 
 
+def resolve_momentum_dtype():
+    """The single resolution point for the momentum STORAGE dtype knob
+    (probes/probe_bf16_momentum.py A/B): the env var, else None (= match
+    params, f32). workload_arrays' trainer cache key and make_trainer
+    must see the SAME value — resolving it twice independently is how a
+    stale-dtype trainer gets silently served from the cache."""
+    import os
+
+    return os.environ.get("MPI_OPT_TPU_MOMENTUM_DTYPE") or None
+
+
 class PopulationWorkload(Workload):
     """Workloads evaluable as rows of a vmapped population (NN models).
 
@@ -80,10 +91,16 @@ class PopulationWorkload(Workload):
             self._data = load_dataset(self.dataset, **kwargs)
         return self._data
 
-    def make_trainer(self, member_chunk: int = 0, donate: bool = True, mesh=None):
+    def make_trainer(
+        self, member_chunk: int = 0, donate: bool = True, mesh=None, momentum_dtype=None
+    ):
+        import jax.numpy as jnp
+
         from mpi_opt_tpu.train import PopulationTrainer
 
         model = self._model(self.data()["n_classes"])
+        if momentum_dtype is None:
+            momentum_dtype = resolve_momentum_dtype()
         return PopulationTrainer(
             apply_fn=lambda params, x: model.apply({"params": params}, x),
             init_fn=lambda rng, sample_x: model.init(rng, sample_x)["params"],
@@ -92,6 +109,7 @@ class PopulationWorkload(Workload):
             member_chunk=member_chunk,
             donate=donate,
             mesh=mesh,
+            momentum_dtype=jnp.dtype(momentum_dtype) if momentum_dtype else None,
         )
 
     def make_hparams(self, values: dict):

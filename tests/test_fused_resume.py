@@ -45,6 +45,62 @@ def test_crash_resume_bit_identical(tmp_path, monkeypatch):
     np.testing.assert_array_equal(resumed["mean_curve"], whole["mean_curve"])
     np.testing.assert_array_equal(resumed["unit"], whole["unit"])
     assert resumed["best_score"] == whole["best_score"]
+    # launch durations survive the crash: pre-crash launches' measured
+    # walls come from the snapshot, the rest are measured live, and the
+    # set aligns with the launch split (launchwise wall-to-target input)
+    assert resumed["launch_gens"] == whole["launch_gens"]
+    assert len(resumed["launch_walls"]) == len(resumed["launch_gens"])
+    assert all(w > 0 for w in resumed["launch_walls"])
+
+
+def test_pre_upgrade_snapshot_resume_reports_no_launch_walls(tmp_path, monkeypatch):
+    """A snapshot from before round 3 lacks BOTH the 'momentum_dtype'
+    config key and the 'launch_walls' meta — emulated by editing the
+    on-disk orbax JSON, exactly what an old snapshot looks like. The
+    resume must (a) not be refused by the config check (an absent key
+    compares as its historical f32 default), (b) produce the
+    bit-identical sweep result, and (c) mark the duration set unknown
+    (None) so the metric helper falls back to whole-sweep prorating
+    instead of crashing on a misaligned list."""
+    import glob
+    import json
+
+    from mpi_opt_tpu.utils.metrics import sweep_wall_to_target
+
+    wl = _wl()
+    whole = fp.fused_pbt(wl, **KW)
+    ckpt = str(tmp_path / "ck")
+    real = fp.run_fused_pbt
+    calls = {"n": 0}
+
+    def crashing(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise RuntimeError("simulated TPU worker crash")
+        return real(*a, **k)
+
+    monkeypatch.setattr(fp, "run_fused_pbt", crashing)
+    with pytest.raises(RuntimeError, match="simulated"):
+        fp.fused_pbt(wl, checkpoint_dir=ckpt, **KW)
+    monkeypatch.setattr(fp, "run_fused_pbt", real)
+
+    hit = 0
+    # orbax's JsonSave lands at <step>/meta/metadata (no extension)
+    for path in glob.glob(f"{ckpt}/*/meta/metadata"):
+        with open(path) as f:
+            d = json.load(f)
+        if isinstance(d, dict) and "config" in d:
+            d["config"].pop("momentum_dtype", None)
+            d.pop("launch_walls", None)
+            with open(path, "w") as f:
+                json.dump(d, f)
+            hit += 1
+    assert hit, "no snapshot meta JSON found to rewrite"
+
+    resumed = fp.fused_pbt(wl, checkpoint_dir=ckpt, **KW)
+    np.testing.assert_array_equal(resumed["best_curve"], whole["best_curve"])
+    assert resumed["launch_walls"] is None
+    assert sweep_wall_to_target(resumed, 10.0, -1.0) == pytest.approx(2.5)
 
 
 def test_resume_after_completion_skips_all_launches(tmp_path, monkeypatch):
@@ -59,6 +115,44 @@ def test_resume_after_completion_skips_all_launches(tmp_path, monkeypatch):
     again = fp.fused_pbt(wl, checkpoint_dir=ckpt, **KW)
     np.testing.assert_array_equal(again["best_curve"], first["best_curve"])
     assert again["best_score"] == first["best_score"]
+
+
+def test_snapshot_last_false_skips_final_save(tmp_path):
+    """A bench-style caller consumes the result immediately; the final
+    launch's snapshot (a multi-GB, minutes-long host fetch at ResNet
+    scale on this platform) must be skippable without losing mid-sweep
+    crash protection."""
+    import os
+
+    wl = _wl()
+    ckpt = str(tmp_path / "ck")
+    fp.fused_pbt(wl, checkpoint_dir=ckpt, snapshot_every=2, snapshot_last=False, **KW)
+    steps = sorted(int(d) for d in os.listdir(ckpt) if d.isdigit())
+    assert steps == [2]  # 4 launches: mid-sweep save kept, final skipped
+
+
+def test_momentum_dtype_mismatch_refuses_resume(tmp_path, monkeypatch):
+    """Momentum storage dtype is carried-state structure: resuming an
+    f32-momentum snapshot under MPI_OPT_TPU_MOMENTUM_DTYPE=bfloat16 must
+    refuse cleanly (config mismatch), not crash in the scan carry."""
+    wl = _wl()
+    ckpt = str(tmp_path / "ck")
+    real = fp.run_fused_pbt
+    calls = {"n": 0}
+
+    def crashing(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("simulated TPU worker crash")
+        return real(*a, **k)
+
+    monkeypatch.setattr(fp, "run_fused_pbt", crashing)
+    with pytest.raises(RuntimeError, match="simulated"):
+        fp.fused_pbt(wl, checkpoint_dir=ckpt, **KW)
+    monkeypatch.setattr(fp, "run_fused_pbt", real)
+    monkeypatch.setenv("MPI_OPT_TPU_MOMENTUM_DTYPE", "bfloat16")
+    with pytest.raises(ValueError, match="different sweep"):
+        fp.fused_pbt(wl, checkpoint_dir=ckpt, **KW)
 
 
 def test_checkpoint_config_mismatch_raises(tmp_path):

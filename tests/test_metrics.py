@@ -2,7 +2,13 @@
 
 import numpy as np
 
-from mpi_opt_tpu.utils.metrics import MetricsLogger, wall_to_target
+import pytest
+
+from mpi_opt_tpu.utils.metrics import (
+    MetricsLogger,
+    wall_to_target,
+    wall_to_target_launchwise,
+)
 
 
 def test_wall_to_target_prorates_by_generation():
@@ -16,6 +22,40 @@ def test_wall_to_target_prorates_by_generation():
     assert wall_to_target([0.75], 10.0, 0.75) == 10.0
     # accepts numpy inputs (the benches pass device-derived arrays)
     assert wall_to_target(np.asarray([0.2, 0.8]), 10.0, 0.5) == 10.0
+
+
+def test_wall_to_target_launchwise_uses_measured_boundaries():
+    # two launches of 2 gens: 10s then 30s (the second launch is slower —
+    # exactly what whole-sweep prorating gets wrong). Target reached at
+    # gen index 2 = first gen of launch 2 -> 10 + 30 * 1/2 = 25.
+    curve = [0.2, 0.4, 0.8, 0.9]
+    assert wall_to_target_launchwise(curve, [2, 2], [10.0, 30.0], 0.75) == 25.0
+    # whole-sweep prorating would have said 40 * 3/4 = 30
+    assert wall_to_target(curve, 40.0, 0.75) == 30.0
+    # reached in the first launch's first gen
+    assert wall_to_target_launchwise([0.9, 0.9], [2], [10.0], 0.5) == 5.0
+    # never reached
+    assert wall_to_target_launchwise([0.1, 0.2], [1, 1], [5.0, 5.0], 0.75) is None
+    # identical launch costs == the uniform assumption: both agree
+    assert wall_to_target_launchwise(curve, [2, 2], [20.0, 20.0], 0.75) == 30.0
+    # misaligned inputs are errors, not silent misattribution
+    with pytest.raises(ValueError, match="align"):
+        wall_to_target_launchwise(curve, [2, 2], [10.0], 0.75)
+    with pytest.raises(ValueError, match="curve"):
+        wall_to_target_launchwise(curve, [2, 3], [10.0, 30.0], 0.75)
+
+
+def test_fused_pbt_reports_launch_walls():
+    """The fused sweep returns measured per-launch durations aligned with
+    its launch split, and a resumed sweep restores pre-crash durations."""
+    from mpi_opt_tpu.train.fused_pbt import fused_pbt
+    from mpi_opt_tpu.workloads import get_workload
+
+    wl = get_workload("fashion_mlp", n_train=512, n_val=256)
+    res = fused_pbt(wl, population=4, generations=3, steps_per_gen=2, seed=0, gen_chunk=2)
+    assert res["launch_gens"] == [2, 1]
+    assert len(res["launch_walls"]) == 2
+    assert all(w > 0 for w in res["launch_walls"])
 
 
 def test_metrics_logger_per_chip_normalization(tmp_path):

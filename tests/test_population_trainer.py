@@ -106,6 +106,31 @@ def test_member_chunk_matches_full_vmap(setup):
     np.testing.assert_allclose(la, lb, rtol=2e-2, atol=2e-5)  # bf16 tolerance
 
 
+def test_momentum_storage_dtype_knob(setup):
+    """momentum_dtype=bfloat16 stores momentum narrow (the bandwidth A/B
+    probe's knob) while params stay f32 and training still learns; the
+    default (None) keeps momentum at the params dtype exactly."""
+    _, data = setup
+    model = MLP(hidden=64, n_classes=10)
+    trainer = PopulationTrainer(
+        apply_fn=lambda p, x: model.apply({"params": p}, x),
+        init_fn=lambda r, x: model.init(r, x)["params"],
+        batch_size=128,
+        momentum_dtype=jnp.bfloat16,
+    )
+    st = trainer.init_population(jax.random.key(3), data["train_x"][:2], 4)
+    assert all(l.dtype == jnp.bfloat16 for l in jax.tree.leaves(st.momentum))
+    assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(st.params))
+    acc0 = trainer.eval_population(st, data["val_x"], data["val_y"])
+    hp = OptHParams.defaults(4, lr=0.1)
+    st, _ = trainer.train_segment(
+        st, hp, data["train_x"], data["train_y"], jax.random.key(4), 60
+    )
+    assert all(l.dtype == jnp.bfloat16 for l in jax.tree.leaves(st.momentum))
+    acc1 = trainer.eval_population(st, data["val_x"], data["val_y"])
+    assert float(acc1.max()) > float(acc0.max()) + 0.1
+
+
 def test_fused_pbt_gen_chunked_launches():
     """gen_chunk is pure launch-splitting: population state AND the
     scan-carried RNG key thread through launches, so a chunked sweep
