@@ -340,11 +340,12 @@ def bench_config5(
             # pop=64) and round-3 measured that at ~5-7 MINUTES through
             # this container's tunnel (~16 MB/s effective) — a platform
             # artifact that makes a save cost MORE than half the sweep's
-            # compute (16 x 21 s). One mid-sweep save bounds a crash's
+            # compute (16 x 21 s). Exactly ONE mid-sweep save (at the
+            # halfway launch, scaling with learn_gens) bounds a crash's
             # rerun cost at ~half the sweep for roughly that price; the
             # end-of-sweep save is skipped because the bench consumes
             # the result immediately and rmtree's the directory
-            snapshot_every=8,
+            snapshot_every=max(1, learn_gens // 2),
             snapshot_last=False,
         )
         lwall = time.perf_counter() - t0

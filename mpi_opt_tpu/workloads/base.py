@@ -48,10 +48,17 @@ def resolve_momentum_dtype():
     (probes/probe_bf16_momentum.py A/B): the env var, else None (= match
     params, f32). workload_arrays' trainer cache key and make_trainer
     must see the SAME value — resolving it twice independently is how a
-    stale-dtype trainer gets silently served from the cache."""
+    stale-dtype trainer gets silently served from the cache. The value
+    is normalized through ``jnp.dtype`` so alias spellings ('f4',
+    'float32') compare equal in checkpoint configs and cache keys."""
     import os
 
-    return os.environ.get("MPI_OPT_TPU_MOMENTUM_DTYPE") or None
+    raw = os.environ.get("MPI_OPT_TPU_MOMENTUM_DTYPE")
+    if not raw:
+        return None
+    import jax.numpy as jnp
+
+    return str(jnp.dtype(raw))
 
 
 class PopulationWorkload(Workload):

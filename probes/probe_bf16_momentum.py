@@ -35,6 +35,9 @@ def run(momentum_dtype, pop=256, gens=2, steps=100):
         generations=gens,
         steps_per_gen=steps,
         seed=0,
+        # bench.py's north-star settings: unchunked pop>=128 fails at the
+        # remote compiler (PERF_NOTES.md "remote-compiler limits")
+        member_chunk=32,
         gen_chunk=1,
     )
     # the env knob is part of workload_arrays' trainer cache key, so
