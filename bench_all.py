@@ -345,7 +345,7 @@ def bench_config5(
             # rerun cost at ~half the sweep for roughly that price; the
             # end-of-sweep save is skipped because the bench consumes
             # the result immediately and rmtree's the directory
-            snapshot_every=max(1, learn_gens // 2),
+            snapshot_every=max(1, -(-learn_gens // 2)),  # ceil: ONE mid save
             snapshot_last=False,
         )
         lwall = time.perf_counter() - t0

@@ -6,6 +6,7 @@ CLI (SURVEY.md §1 CLI layer; reference unreadable).
 
 from mpi_opt_tpu.algorithms.asha import ASHA
 from mpi_opt_tpu.algorithms.base import Algorithm
+from mpi_opt_tpu.algorithms.bohb import BOHB
 from mpi_opt_tpu.algorithms.hyperband import Hyperband
 from mpi_opt_tpu.algorithms.pbt import PBT
 from mpi_opt_tpu.algorithms.random_search import RandomSearch
@@ -17,6 +18,7 @@ ALGORITHMS: dict[str, type[Algorithm]] = {
     PBT.name: PBT,
     TPE.name: TPE,
     Hyperband.name: Hyperband,
+    BOHB.name: BOHB,
 }
 
 
@@ -34,6 +36,7 @@ __all__ = [
     "RandomSearch",
     "ASHA",
     "Hyperband",
+    "BOHB",
     "PBT",
     "TPE",
     "ALGORITHMS",

@@ -39,8 +39,9 @@ class ASHA(Algorithm):
         min_budget: int = 1,
         max_budget: int = 27,
         eta: int = 3,
+        id_base: int = 0,
     ):
-        super().__init__(space, seed)
+        super().__init__(space, seed, id_base=id_base)
         self.max_trials = max_trials
         self.eta = eta
         self.rungs = asha_rungs(min_budget, max_budget, eta)
@@ -66,7 +67,7 @@ class ASHA(Algorithm):
             out.append(t)
         while len(out) < n and self._suggested < self.max_trials:
             key = jax.random.fold_in(jax.random.key(self.seed), self._suggested)
-            unit = np.asarray(self.space.sample_unit(key, 1))[0]
+            unit = self._sample_fresh(key)
             t = self._new_trial(unit, budget=self.rungs[0])
             t.status = TrialStatus.RUNNING
             out.append(t)
@@ -97,6 +98,14 @@ class ASHA(Algorithm):
         return (
             no_new and not self._promotable and not self._outstanding and not self._requeue
         )
+
+    # -- fresh-trial sampling (overridable: BOHB swaps in a model) --------
+
+    def _sample_fresh(self, key) -> np.ndarray:
+        """Unit-cube row for a brand-new trial. ASHA itself samples
+        uniformly; model-based variants (algorithms/bohb.py) override
+        this single point to keep the halving logic one source of truth."""
+        return np.asarray(self.space.sample_unit(key, 1))[0]
 
     # -- promotion rule ---------------------------------------------------
 

@@ -36,11 +36,18 @@ class Algorithm(abc.ABC):
 
     name: str = "base"
 
-    def __init__(self, space: SearchSpace, seed: int = 0):
+    def __init__(self, space: SearchSpace, seed: int = 0, id_base: int = 0):
         self.space = space
         self.seed = seed
         self.trials: dict[int, Trial] = {}
-        self._next_id = 0
+        # id_base partitions the trial-id space when several Algorithm
+        # instances share one search/backend (Hyperband/BOHB brackets):
+        # stateful backends key their ledgers on trial_id, so two
+        # brackets both starting at 0 would silently alias — bracket 2's
+        # trial 0 warm-resumes bracket 1's trained state instead of
+        # training fresh (see Backend.reset for the one-search form of
+        # the same hazard)
+        self._next_id = id_base
         self._requeue: list[int] = []  # in-flight trials recovered from a checkpoint
 
     # -- core contract ----------------------------------------------------

@@ -1,0 +1,176 @@
+"""BOHB: model-based Hyperband (Falkner, Klein & Hutter, 2018).
+
+Extension beyond the reference's algorithm set (SURVEY.md §2 rows 4/6
+attest ASHA and TPE separately; BOHB is their standard composition):
+Hyperband's bracket schedule decides WHEN to stop trials, while a TPE
+model decides WHERE to sample new ones — replacing each bracket's
+uniform sampling with draws from the acquisition kernel fit on
+completed observations.
+
+Composition design (one source of truth, same as Hyperband's):
+
+- brackets are ``ASHA`` instances via ``Hyperband._make_bracket``; the
+  ONLY override is ``_sample_fresh`` — promotion rules, requeue-on-
+  resume, and checkpointing all come along unchanged;
+- the surrogate is the existing vectorized TPE acquisition
+  (``ops.tpe.tpe_suggest``) — no second KDE implementation. BOHB fits
+  it on the observations of the HIGHEST budget that has at least
+  ``n_min`` of them (the paper's rule: models at bigger budgets are
+  more informative, smaller budgets fill in first), falling back to
+  uniform until any budget qualifies;
+- a ``random_fraction`` of fresh trials stays uniform regardless
+  (the paper's ρ, default 1/3), preserving Hyperband's worst-case
+  guarantees over a misleading model.
+
+Per-budget observation stores are bounded ring buffers like TPE's own.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+
+from mpi_opt_tpu.algorithms.asha import ASHA
+from mpi_opt_tpu.algorithms.hyperband import Hyperband
+from mpi_opt_tpu.ops.tpe import TPEConfig, tpe_suggest
+from mpi_opt_tpu.space import SearchSpace
+from mpi_opt_tpu.trial import TrialResult
+
+
+class _ModelBracket(ASHA):
+    """ASHA bracket whose fresh trials come from the owning BOHB's
+    model (uniform until it qualifies / for the random fraction)."""
+
+    def __init__(self, owner: "BOHB", **kw):
+        super().__init__(owner.space, **kw)
+        self._owner = owner
+
+    def _sample_fresh(self, key) -> np.ndarray:
+        return self._owner._model_sample(key)
+
+
+class BOHB(Hyperband):
+    name = "bohb"
+
+    def __init__(
+        self,
+        space: SearchSpace,
+        seed: int = 0,
+        max_budget: int = 81,
+        eta: int = 3,
+        random_fraction: float = 1 / 3,
+        n_min: int | None = None,
+        buffer_size: int = 512,
+        config: TPEConfig = TPEConfig(),
+    ):
+        # model state must exist before Hyperband.__init__ builds the
+        # brackets (their construction calls back into _make_bracket)
+        self.random_fraction = random_fraction
+        self.config = config
+        self.buffer_size = buffer_size
+        # the paper's minimum: d+2 observations before a KDE is fit
+        self.n_min = n_min if n_min is not None else space.dim + 2
+        self._obs: dict[int, dict] = {}  # budget -> ring {unit, score, valid, n}
+        self._samples = 0  # fold-in counter for model/uniform draws
+        super().__init__(space, seed=seed, max_budget=max_budget, eta=eta)
+        self._suggest_fn = jax.jit(tpe_suggest, static_argnames=("n_suggest", "cfg"))
+
+    def _make_bracket(self, b: int, n: int, r: int) -> ASHA:
+        return _ModelBracket(
+            self,
+            seed=self.seed + 7919 * b,
+            max_trials=n,
+            min_budget=r,
+            max_budget=self.max_budget,
+            eta=self.eta,
+            id_base=b * 1_000_000,  # see Hyperband._make_bracket
+        )
+
+    # -- model ------------------------------------------------------------
+
+    def _store(self, budget: int) -> dict:
+        if budget not in self._obs:
+            self._obs[budget] = {
+                "unit": np.zeros((self.buffer_size, self.space.dim), np.float32),
+                "score": np.zeros(self.buffer_size, np.float32),
+                "valid": np.zeros(self.buffer_size, bool),
+                "n": 0,
+            }
+        return self._obs[budget]
+
+    def _model_budget(self) -> int | None:
+        """Highest budget whose observation count reaches n_min."""
+        good = [b for b, s in self._obs.items() if min(s["n"], self.buffer_size) >= self.n_min]
+        return max(good) if good else None
+
+    def _model_sample(self, key) -> np.ndarray:
+        self._samples += 1
+        k_choice, k_draw = jax.random.split(jax.random.fold_in(key, self._samples))
+        budget = self._model_budget()
+        if budget is None or float(jax.random.uniform(k_choice)) < self.random_fraction:
+            return np.asarray(self.space.sample_unit(k_draw, 1))[0]
+        s = self._obs[budget]
+        sugg, _ = self._suggest_fn(
+            k_draw, s["unit"], s["score"], s["valid"], n_suggest=1, cfg=self.config
+        )
+        return np.asarray(sugg)[0]
+
+    # -- result flow -------------------------------------------------------
+
+    def report_batch(self, results: Sequence[TrialResult]):
+        # feed the per-budget model stores BEFORE the bracket applies its
+        # halving rule; r.step is the cumulative budget the trial reached
+        bracket = self.brackets[self._cur]
+        for r in results:
+            t = bracket.trials[r.trial_id]
+            s = self._store(int(r.step))
+            slot = s["n"] % self.buffer_size
+            s["unit"][slot] = t.unit
+            s["score"][slot] = r.score
+            s["valid"][slot] = True
+            s["n"] += 1
+        super().report_batch(results)
+
+    # -- checkpoint -------------------------------------------------------
+
+    def state_dict(self):
+        d = super().state_dict()
+        d["bohb"] = {
+            "samples": self._samples,
+            "buffer_size": self.buffer_size,
+            "obs": {
+                str(b): {
+                    "unit": s["unit"].tolist(),
+                    "score": s["score"].tolist(),
+                    "valid": s["valid"].tolist(),
+                    "n": s["n"],
+                }
+                for b, s in self._obs.items()
+            },
+        }
+        return d
+
+    def load_state_dict(self, state):
+        super().load_state_dict(state)
+        b = state["bohb"]
+        # ring slot arithmetic (n % buffer_size) silently corrupts — or
+        # IndexErrors mid-search — under a changed buffer size; refuse
+        # like Hyperband refuses a changed R/eta
+        saved = int(b.get("buffer_size", self.buffer_size))
+        if saved != self.buffer_size:
+            raise ValueError(
+                f"checkpoint is for bohb(buffer_size={saved}), "
+                f"not buffer_size={self.buffer_size}"
+            )
+        self._samples = int(b["samples"])
+        self._obs = {
+            int(k): {
+                "unit": np.asarray(s["unit"], np.float32),
+                "score": np.asarray(s["score"], np.float32),
+                "valid": np.asarray(s["valid"], bool),
+                "n": int(s["n"]),
+            }
+            for k, s in b["obs"].items()
+        }

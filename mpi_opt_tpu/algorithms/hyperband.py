@@ -59,18 +59,25 @@ class Hyperband(Algorithm):
         self.eta = eta
         self.max_budget = max_budget
         self.brackets = [
-            ASHA(
-                space,
-                # decorrelate bracket sampling; deterministic per bracket
-                seed=seed + 7919 * b,
-                max_trials=n,
-                min_budget=r,
-                max_budget=max_budget,
-                eta=eta,
-            )
+            self._make_bracket(b, n, r)
             for b, (n, r) in enumerate(bracket_plan(max_budget, eta))
         ]
         self._cur = 0
+
+    def _make_bracket(self, b: int, n: int, r: int) -> ASHA:
+        """Bracket factory (overridable: BOHB builds model-sampling
+        brackets). Seeds are decorrelated per bracket, deterministic;
+        id_base partitions the trial-id space so brackets sharing one
+        stateful backend can never alias each other's ledger entries."""
+        return ASHA(
+            self.space,
+            seed=self.seed + 7919 * b,
+            max_trials=n,
+            min_budget=r,
+            max_budget=self.max_budget,
+            eta=self.eta,
+            id_base=b * 1_000_000,
+        )
 
     # -- contract ---------------------------------------------------------
 

@@ -155,7 +155,7 @@ def make_algorithm(args, space):
             max_budget=args.max_budget,
             eta=args.eta,
         )
-    if args.algorithm == "hyperband":
+    if args.algorithm in ("hyperband", "bohb"):
         return cls(space, seed=args.seed, max_budget=args.max_budget, eta=args.eta)
     if args.algorithm == "pbt":
         return cls(

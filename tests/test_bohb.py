@@ -1,0 +1,113 @@
+"""BOHB: model-based Hyperband — bracket composition, model gating,
+id-space partitioning, checkpoint roundtrip, end-to-end search."""
+
+import jax
+import numpy as np
+import pytest
+
+from mpi_opt_tpu.algorithms import BOHB, Hyperband, get_algorithm
+from mpi_opt_tpu.backends.cpu import CPUBackend
+from mpi_opt_tpu.driver import run_search
+from mpi_opt_tpu.workloads import get_workload
+
+
+def _space():
+    return get_workload("quadratic").default_space()
+
+
+def test_registered():
+    assert get_algorithm("bohb") is BOHB
+
+
+def test_uniform_until_model_qualifies():
+    """Before any budget accumulates n_min observations, every draw is
+    uniform; after feeding one budget past n_min, non-random draws come
+    from the acquisition kernel (deterministically, given the key)."""
+    space = _space()
+    algo = BOHB(space, seed=0, max_budget=9, eta=3, random_fraction=0.0)
+    assert algo._model_budget() is None
+    key = jax.random.key(1)
+    u = algo._model_sample(key)
+    assert u.shape == (space.dim,)
+
+    # feed a discriminative history at budget 9: high scores cluster at
+    # 0.2, low scores at 0.8 (every dim), well past n_min points
+    s = algo._store(9)
+    rng = np.random.default_rng(0)
+    n = max(4 * algo.n_min, 24)
+    for i in range(n):
+        good = i % 2 == 0
+        center = 0.2 if good else 0.8
+        s["unit"][i] = np.clip(center + 0.03 * rng.standard_normal(algo.space.dim), 0, 1)
+        s["score"][i] = (1.0 if good else 0.0) + 0.01 * rng.standard_normal()
+        s["valid"][i] = True
+        s["n"] += 1
+    assert algo._model_budget() == 9
+    draws = np.stack([algo._model_sample(jax.random.fold_in(key, i)) for i in range(16)])
+    # the model concentrates samples toward the good cluster
+    m = float(draws[:, 0].mean())
+    assert abs(m - 0.2) < abs(m - 0.8), f"model samples not biased to the good cluster: {m}"
+
+
+def test_model_prefers_highest_qualified_budget():
+    algo = BOHB(_space(), seed=0, max_budget=27, eta=3)
+    for b in (1, 3, 9):
+        s = algo._store(b)
+        s["n"] = algo.n_min + 1
+    assert algo._model_budget() == 9
+
+
+def test_bracket_ids_are_disjoint():
+    """Brackets share one (possibly stateful) backend; their trial-id
+    ranges must never overlap or bracket 2's fresh trials would warm-
+    resume bracket 1's ledger entries (Backend.reset's hazard, in its
+    multi-Algorithm form). Applies to Hyperband and BOHB alike."""
+    for cls in (Hyperband, BOHB):
+        algo = cls(_space(), seed=0, max_budget=27, eta=3)
+        seen = set()
+        for b in algo.brackets:
+            batch = b.next_batch(1000)
+            ids = {t.trial_id for t in batch}
+            assert not (ids & seen), f"{cls.name}: overlapping trial ids"
+            seen |= ids
+
+
+def test_bohb_driver_loop_completes_and_uses_model():
+    wl = get_workload("quadratic")
+    algo = BOHB(wl.default_space(), seed=0, max_budget=27, eta=3)
+    be = CPUBackend(wl, n_workers=1)
+    try:
+        res = run_search(algo, be)
+    finally:
+        be.close()
+    assert algo.finished()
+    assert res.n_trials == 27 + 12 + 6 + 4  # same plan as hyperband R=27
+    assert res.best is not None and res.best.score is not None
+    # the later brackets ran with a qualified model (enough budget-1
+    # observations exist after bracket 0's first rung alone)
+    assert algo._model_budget() is not None
+
+
+def test_bohb_checkpoint_roundtrip():
+    wl = get_workload("quadratic")
+    space = wl.default_space()
+    algo = BOHB(space, seed=3, max_budget=27, eta=3)
+    be = CPUBackend(wl, n_workers=1)
+    try:
+        run_search(algo, be, max_batches=3)
+        mid = algo.state_dict()
+        resumed = BOHB(space, seed=3, max_budget=27, eta=3)
+        resumed.load_state_dict(mid)
+        assert resumed._samples == algo._samples
+        for b in algo._obs:
+            np.testing.assert_array_equal(resumed._obs[b]["unit"], algo._obs[b]["unit"])
+            assert resumed._obs[b]["n"] == algo._obs[b]["n"]
+        r1 = run_search(algo, be)
+        be.reset()
+        r2 = run_search(resumed, be)
+    finally:
+        be.close()
+    assert r1.best is not None and r2.best is not None
+    # both complete the full plan (arrival-order effects can differ, as
+    # with hyperband's resume; completion and a sane best are the contract)
+    assert algo.finished() and resumed.finished()
