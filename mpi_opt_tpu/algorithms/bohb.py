@@ -39,6 +39,75 @@ from mpi_opt_tpu.space import SearchSpace
 from mpi_opt_tpu.trial import TrialResult
 
 
+class ObsStore:
+    """Per-budget ring buffers of (unit, score) observations plus the
+    highest-qualified-budget rule — BOHB's model bookkeeping, shared by
+    the host algorithm and the fused sweeps so the qualification and
+    ring-wrap arithmetic cannot drift between them."""
+
+    def __init__(self, dim: int, buffer_size: int, n_min: int):
+        self.dim = dim
+        self.buffer_size = buffer_size
+        self.n_min = n_min
+        self.budgets: dict[int, dict] = {}
+
+    def ring(self, budget: int) -> dict:
+        if budget not in self.budgets:
+            self.budgets[budget] = {
+                "unit": np.zeros((self.buffer_size, self.dim), np.float32),
+                "score": np.zeros(self.buffer_size, np.float32),
+                "valid": np.zeros(self.buffer_size, bool),
+                "n": 0,
+            }
+        return self.budgets[budget]
+
+    def add(self, budget: int, unit: np.ndarray, score: float) -> None:
+        # NaN scores (diverged trials) never enter the model: they would
+        # count toward n_min qualification and poison the KDE split.
+        # Filtered HERE so the host and fused paths cannot disagree.
+        if np.isnan(score):
+            return
+        s = self.ring(int(budget))
+        slot = s["n"] % self.buffer_size
+        s["unit"][slot] = unit
+        s["score"][slot] = score
+        s["valid"][slot] = True
+        s["n"] += 1
+
+    def model_budget(self):
+        """Highest budget whose live observation count reaches n_min."""
+        good = [
+            b
+            for b, s in self.budgets.items()
+            if min(s["n"], self.buffer_size) >= self.n_min
+        ]
+        return max(good) if good else None
+
+    # -- (de)serialization for algorithm checkpoints ----------------------
+
+    def to_jsonable(self) -> dict:
+        return {
+            str(b): {
+                "unit": s["unit"].tolist(),
+                "score": s["score"].tolist(),
+                "valid": s["valid"].tolist(),
+                "n": s["n"],
+            }
+            for b, s in self.budgets.items()
+        }
+
+    def load_jsonable(self, d: dict) -> None:
+        self.budgets = {
+            int(k): {
+                "unit": np.asarray(s["unit"], np.float32),
+                "score": np.asarray(s["score"], np.float32),
+                "valid": np.asarray(s["valid"], bool),
+                "n": int(s["n"]),
+            }
+            for k, s in d.items()
+        }
+
+
 class _ModelBracket(ASHA):
     """ASHA bracket whose fresh trials come from the owning BOHB's
     model (uniform until it qualifies / for the random fraction)."""
@@ -72,38 +141,21 @@ class BOHB(Hyperband):
         self.buffer_size = buffer_size
         # the paper's minimum: d+2 observations before a KDE is fit
         self.n_min = n_min if n_min is not None else space.dim + 2
-        self._obs: dict[int, dict] = {}  # budget -> ring {unit, score, valid, n}
+        self.obs = ObsStore(space.dim, buffer_size, self.n_min)
         self._samples = 0  # fold-in counter for model/uniform draws
         super().__init__(space, seed=seed, max_budget=max_budget, eta=eta)
         self._suggest_fn = jax.jit(tpe_suggest, static_argnames=("n_suggest", "cfg"))
 
-    def _make_bracket(self, b: int, n: int, r: int) -> ASHA:
-        return _ModelBracket(
-            self,
-            seed=self.seed + 7919 * b,
-            max_trials=n,
-            min_budget=r,
-            max_budget=self.max_budget,
-            eta=self.eta,
-            id_base=b * 1_000_000,  # see Hyperband._make_bracket
-        )
+    def _bracket(self, **kw) -> ASHA:
+        # Hyperband._make_bracket computes the per-bracket seed/id_base
+        # scheme; overriding only the construction point keeps that
+        # scheme single-sourced
+        return _ModelBracket(self, **kw)
 
     # -- model ------------------------------------------------------------
 
-    def _store(self, budget: int) -> dict:
-        if budget not in self._obs:
-            self._obs[budget] = {
-                "unit": np.zeros((self.buffer_size, self.space.dim), np.float32),
-                "score": np.zeros(self.buffer_size, np.float32),
-                "valid": np.zeros(self.buffer_size, bool),
-                "n": 0,
-            }
-        return self._obs[budget]
-
     def _model_budget(self) -> int | None:
-        """Highest budget whose observation count reaches n_min."""
-        good = [b for b, s in self._obs.items() if min(s["n"], self.buffer_size) >= self.n_min]
-        return max(good) if good else None
+        return self.obs.model_budget()
 
     def _model_sample(self, key) -> np.ndarray:
         self._samples += 1
@@ -111,7 +163,7 @@ class BOHB(Hyperband):
         budget = self._model_budget()
         if budget is None or float(jax.random.uniform(k_choice)) < self.random_fraction:
             return np.asarray(self.space.sample_unit(k_draw, 1))[0]
-        s = self._obs[budget]
+        s = self.obs.budgets[budget]
         sugg, _ = self._suggest_fn(
             k_draw, s["unit"], s["score"], s["valid"], n_suggest=1, cfg=self.config
         )
@@ -125,12 +177,7 @@ class BOHB(Hyperband):
         bracket = self.brackets[self._cur]
         for r in results:
             t = bracket.trials[r.trial_id]
-            s = self._store(int(r.step))
-            slot = s["n"] % self.buffer_size
-            s["unit"][slot] = t.unit
-            s["score"][slot] = r.score
-            s["valid"][slot] = True
-            s["n"] += 1
+            self.obs.add(int(r.step), t.unit, float(r.score))
         super().report_batch(results)
 
     # -- checkpoint -------------------------------------------------------
@@ -140,37 +187,22 @@ class BOHB(Hyperband):
         d["bohb"] = {
             "samples": self._samples,
             "buffer_size": self.buffer_size,
-            "obs": {
-                str(b): {
-                    "unit": s["unit"].tolist(),
-                    "score": s["score"].tolist(),
-                    "valid": s["valid"].tolist(),
-                    "n": s["n"],
-                }
-                for b, s in self._obs.items()
-            },
+            "obs": self.obs.to_jsonable(),
         }
         return d
 
     def load_state_dict(self, state):
-        super().load_state_dict(state)
         b = state["bohb"]
-        # ring slot arithmetic (n % buffer_size) silently corrupts — or
-        # IndexErrors mid-search — under a changed buffer size; refuse
-        # like Hyperband refuses a changed R/eta
+        # validate BEFORE any mutation (matching Hyperband's R/eta
+        # check): ring slot arithmetic (n % buffer_size) silently
+        # corrupts — or IndexErrors mid-search — under a changed buffer
+        # size, and a refusal must not leave the instance half-loaded
         saved = int(b.get("buffer_size", self.buffer_size))
         if saved != self.buffer_size:
             raise ValueError(
                 f"checkpoint is for bohb(buffer_size={saved}), "
                 f"not buffer_size={self.buffer_size}"
             )
+        super().load_state_dict(state)
         self._samples = int(b["samples"])
-        self._obs = {
-            int(k): {
-                "unit": np.asarray(s["unit"], np.float32),
-                "score": np.asarray(s["score"], np.float32),
-                "valid": np.asarray(s["valid"], bool),
-                "n": int(s["n"]),
-            }
-            for k, s in b["obs"].items()
-        }
+        self.obs.load_jsonable(b["obs"])

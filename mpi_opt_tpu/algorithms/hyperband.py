@@ -65,12 +65,12 @@ class Hyperband(Algorithm):
         self._cur = 0
 
     def _make_bracket(self, b: int, n: int, r: int) -> ASHA:
-        """Bracket factory (overridable: BOHB builds model-sampling
-        brackets). Seeds are decorrelated per bracket, deterministic;
-        id_base partitions the trial-id space so brackets sharing one
-        stateful backend can never alias each other's ledger entries."""
-        return ASHA(
-            self.space,
+        """The per-bracket scheme, single-sourced for every subclass:
+        seeds are decorrelated per bracket (deterministic), and id_base
+        partitions the trial-id space so brackets sharing one stateful
+        backend can never alias each other's ledger entries. Subclasses
+        override ``_bracket`` (the construction point), not this."""
+        return self._bracket(
             seed=self.seed + 7919 * b,
             max_trials=n,
             min_budget=r,
@@ -78,6 +78,9 @@ class Hyperband(Algorithm):
             eta=self.eta,
             id_base=b * 1_000_000,
         )
+
+    def _bracket(self, **kw) -> ASHA:
+        return ASHA(self.space, **kw)
 
     # -- contract ---------------------------------------------------------
 

@@ -286,8 +286,24 @@ def run_fused(args, parser, workload) -> int:
             )
             n_trials = res["n_trials"]
             extra = {"brackets": res["brackets"]}
+        elif args.algorithm == "bohb":
+            from mpi_opt_tpu.train.fused_bohb import fused_bohb
+
+            res = fused_bohb(
+                workload,
+                max_budget=args.max_budget,
+                eta=args.eta,
+                seed=args.seed,
+                member_chunk=args.member_chunk,
+                mesh=mesh,
+                checkpoint_dir=args.checkpoint_dir,
+            )
+            n_trials = res["n_trials"]
+            extra = {"brackets": res["brackets"]}
         else:
-            parser.error(f"--fused supports pbt/asha/hyperband/tpe, not {args.algorithm!r}")
+            parser.error(
+                f"--fused supports pbt/asha/hyperband/bohb/tpe, not {args.algorithm!r}"
+            )
     wall = time.perf_counter() - t0
     metrics.count_trials(n_trials)
     summary = {

@@ -27,6 +27,7 @@ reference collapse into one on-device top-k + gather.
 from __future__ import annotations
 
 import functools
+import hashlib
 
 import jax
 import numpy as np
@@ -73,11 +74,19 @@ def fused_sha(
     mesh=None,
     round_to: int = 1,
     checkpoint_dir: str = None,
+    init_unit=None,
 ):
     """Run a whole successive-halving sweep with on-device rung cuts.
 
     Returns a dict with the best trial's score/params, per-rung sizes
     and budgets, and a per-trial ledger (stop rung + last score).
+
+    ``init_unit`` (optional float[n_trials, dim] in the unit cube)
+    replaces the uniform initial cohort — fused BOHB passes
+    model-sampled configurations here. The checkpoint config records a
+    digest of it, so a resume under different initial configurations is
+    refused (deterministic callers like fused_bohb regenerate the same
+    matrix, so their resumes still match).
 
     ``checkpoint_dir`` makes the sweep crash-recoverable at RUNG
     granularity (same failure model as fused_pbt's launch snapshots):
@@ -97,6 +106,13 @@ def fused_sha(
         round_to = mesh.shape["pop"]
     sizes = sha_cohort_sizes(n_trials, len(rungs), eta, round_to)
 
+    if init_unit is not None:
+        init_unit = np.asarray(init_unit, dtype=np.float32)
+        if init_unit.shape != (n_trials, space.dim):
+            raise ValueError(
+                f"init_unit shape {init_unit.shape} != ({n_trials}, {space.dim})"
+            )
+
     key = jax.random.key(seed)
     k_init, k_unit, k_run = jax.random.split(key, 3)
 
@@ -104,6 +120,10 @@ def fused_sha(
     alive = np.arange(n_trials)
     stop_rung = np.zeros(n_trials, dtype=np.int32)
     last_score = np.full(n_trials, np.nan, dtype=np.float32)
+    # every (trial, budget, score) observation, one entry per rung —
+    # model-based callers (fused BOHB) consume ALL of a trial's scores,
+    # not just the one at its stop rung
+    rung_history: list = []
 
     # restore BEFORE initializing: a resumed sweep must not pay (or
     # transiently hold the memory of) a full-cohort init it discards
@@ -127,6 +147,14 @@ def fused_sha(
                 # carried-state structure (see fused_pbt): a resumed rung
                 # must find momentum in the dtype it was saved with
                 "momentum_dtype": momentum_dtype_str(),
+                # the initial cohort defines the sweep: a resume whose
+                # caller supplies different configurations is a
+                # different search and must be refused
+                "init_unit_digest": (
+                    None
+                    if init_unit is None
+                    else hashlib.sha1(init_unit.tobytes()).hexdigest()
+                ),
             },
         )
         restored = snap.restore_population_sweep()
@@ -136,8 +164,15 @@ def fused_sha(
             stop_rung = np.asarray(meta["stop_rung"], dtype=np.int32)
             last_score = np.asarray(meta["last_score"], dtype=np.float32)
             start_rung = int(meta["rungs_done"])
+            # pre-upgrade snapshots have no history; completed rungs'
+            # stop-rung observations are still in last_score, so the
+            # history is marked partial rather than fabricated
+            rung_history = list(meta.get("rung_history", []))
     if restored is None:
-        unit = space.sample_unit(k_unit, n_trials)
+        if init_unit is not None:
+            unit = jax.numpy.asarray(init_unit)
+        else:
+            unit = space.sample_unit(k_unit, n_trials)
         state = trainer.init_population(k_init, train_x[:2], n_trials)
     if mesh is not None:
         # datasets were already replicated over the mesh by workload_arrays
@@ -157,6 +192,13 @@ def fused_sha(
             np_scores = np.asarray(scores)
             stop_rung[alive] = r
             last_score[alive] = np_scores
+            rung_history.append(
+                {
+                    "budget": int(budget),
+                    "trials": [int(i) for i in alive],
+                    "scores": [float(v) for v in np_scores],
+                }
+            )
             if r < len(rungs) - 1:
                 state, unit, keep, _ = _cut_and_gather(
                     trainer, state, unit, scores, eta, sizes[r + 1]
@@ -177,6 +219,7 @@ def fused_sha(
                         "alive": alive.tolist(),
                         "stop_rung": stop_rung.tolist(),
                         "last_score": [float(v) for v in last_score],
+                        "rung_history": rung_history,
                     },
                 )
     finally:
@@ -193,6 +236,7 @@ def fused_sha(
         "rung_sizes": sizes,
         "stop_rung": stop_rung,
         "last_score": last_score,
+        "rung_history": rung_history,
         "n_trials": n_trials,
     }
 
@@ -206,6 +250,8 @@ def fused_hyperband(
     mesh=None,
     round_to: int = 1,
     checkpoint_dir: str = None,
+    cohort_fn=None,
+    observe_fn=None,
 ):
     """Hyperband with every bracket running as a fused on-device SHA.
 
@@ -214,6 +260,13 @@ def fused_hyperband(
     cohort trains/cuts on-device; between brackets there is one host
     transition. Bracket seeds match the host-side ``Hyperband``
     algorithm's (seed + 7919*b).
+
+    ``cohort_fn(b, n) -> (unit[n, dim], n_model)`` / ``observe_fn(b,
+    cohort, res)`` are the model hooks fused BOHB plugs in (sample each
+    bracket's initial configurations; feed the results back). Plain
+    Hyperband is the hookless case — ONE bracket loop serves both, so
+    the seed scheme, per-bracket checkpoint layout, and best-pick can
+    never drift between them.
 
     Returns the overall best plus a per-bracket summary.
 
@@ -230,6 +283,7 @@ def fused_hyperband(
     brackets = []
     n_total = 0
     for b, (n, r) in enumerate(bracket_plan(max_budget, eta)):
+        cohort, n_model = (None, None) if cohort_fn is None else cohort_fn(b, n)
         res = fused_sha(
             workload,
             n_trials=n,
@@ -243,19 +297,29 @@ def fused_hyperband(
             checkpoint_dir=(
                 os.path.join(checkpoint_dir, f"bracket_{b}") if checkpoint_dir else None
             ),
+            init_unit=cohort,
         )
+        if observe_fn is not None:
+            observe_fn(b, cohort, res)
         n_total += n
-        brackets.append(
-            {
-                "bracket": b,
-                "n_trials": n,
-                "start_budget": r,
-                "rung_sizes": res["rung_sizes"],
-                "rung_budgets": res["rung_budgets"],
-                "best_score": res["best_score"],
-            }
-        )
-        if best is None or res["best_score"] > best["best_score"]:
+        summary = {
+            "bracket": b,
+            "n_trials": n,
+            "start_budget": r,
+            "rung_sizes": res["rung_sizes"],
+            "rung_budgets": res["rung_budgets"],
+            "best_score": res["best_score"],
+        }
+        if cohort_fn is not None:
+            summary["n_model_sampled"] = n_model
+        brackets.append(summary)
+        # NaN-safe best-pick: a diverged bracket (best_score NaN) must
+        # never stick — `x > nan` is False for every x, so the naive
+        # comparison would freeze the NaN as the winner forever
+        score = res["best_score"]
+        score = float("-inf") if np.isnan(score) else score
+        best_sc = float("-inf") if best is None or np.isnan(best["best_score"]) else best["best_score"]
+        if best is None or score > best_sc:
             best = res
     return {
         "best_score": best["best_score"],

@@ -1,0 +1,102 @@
+"""Fused BOHB: model-based Hyperband with on-device brackets.
+
+The bracket execution IS ``fused_hyperband`` (one shared loop: seed
+scheme, per-bracket rung-checkpoint layout, NaN-safe best-pick); this
+module only supplies the two model hooks — sample each bracket's
+initial cohort from a TPE model, feed every rung's results back. The
+sampling rules match ``algorithms/bohb.py`` (random-fraction hedge,
+highest-qualified-budget, n_min gate) and the bookkeeping is the SAME
+``ObsStore`` helper, so the two BOHB implementations cannot drift.
+
+The model work is a single batched ``tpe_suggest`` call per bracket —
+the vectorized acquisition scores the whole cohort's candidates at
+once, where the host-driver BOHB draws one suggestion per trial.
+
+Observation bookkeeping: fused_sha's ``rung_history`` ledger records
+every cohort's scores at every rung, so a trial promoted through three
+rungs contributes three observations at three budgets — the same
+observation set the host algorithm's ``report_batch`` accumulates.
+
+Crash recovery: brackets checkpoint individually (rung granularity,
+``bracket_b`` subdirectories). The model's inputs are the completed
+brackets' results, which replay bit-identically from their snapshots,
+and the sampling keys are deterministic — so a resumed fused BOHB
+regenerates the SAME initial cohorts (fused_sha additionally records a
+digest of each cohort and refuses a mismatch).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from mpi_opt_tpu.algorithms.bohb import ObsStore
+from mpi_opt_tpu.ops.tpe import TPEConfig, tpe_suggest
+from mpi_opt_tpu.train.common import workload_arrays
+from mpi_opt_tpu.train.fused_asha import fused_hyperband
+
+
+def fused_bohb(
+    workload,
+    max_budget: int = 270,
+    eta: int = 3,
+    seed: int = 0,
+    member_chunk: int = 0,
+    mesh=None,
+    round_to: int = 1,
+    checkpoint_dir: str = None,
+    random_fraction: float = 1 / 3,
+    n_min: int | None = None,
+    buffer_size: int = 512,
+    cfg: TPEConfig = TPEConfig(),
+):
+    """Returns the overall best plus per-bracket summaries (including
+    how many of each cohort came from the model vs uniform)."""
+    _, space, *_ = workload_arrays(workload, member_chunk, mesh)
+    if n_min is None:
+        n_min = space.dim + 2
+    obs = ObsStore(space.dim, buffer_size, n_min)
+    suggest = jax.jit(tpe_suggest, static_argnames=("n_suggest", "cfg"))
+
+    def cohort_fn(b: int, n: int):
+        """(initial unit matrix, model-drawn count) for bracket b: model
+        draws where a budget qualifies, uniform for the random fraction
+        (and always before any budget qualifies)."""
+        key = jax.random.fold_in(jax.random.key(seed), 104729 + b)
+        k_mask, k_rand, k_model = jax.random.split(key, 3)
+        budget = obs.model_budget()
+        # np.array (copy): asarray of a device array is a READ-ONLY view
+        uniform = np.array(space.sample_unit(k_rand, n))
+        if budget is None:
+            return uniform, 0
+        from_model = np.asarray(jax.random.uniform(k_mask, (n,)) >= random_fraction)
+        n_model = int(from_model.sum())
+        if n_model == 0:
+            return uniform, 0
+        s = obs.budgets[budget]
+        # one batched, diversified acquisition call for the whole cohort
+        sugg, _ = suggest(
+            k_model, s["unit"], s["score"], s["valid"], n_suggest=n_model, cfg=cfg
+        )
+        cohort = uniform
+        cohort[from_model] = np.asarray(sugg)[:n_model]
+        return cohort, n_model
+
+    def observe_fn(b: int, cohort: np.ndarray, res: dict):
+        # every rung's scores feed the model (ObsStore drops NaNs)
+        for rung in res["rung_history"]:
+            for i, sc in zip(rung["trials"], rung["scores"]):
+                obs.add(rung["budget"], cohort[int(i)], float(sc))
+
+    return fused_hyperband(
+        workload,
+        max_budget=max_budget,
+        eta=eta,
+        seed=seed,
+        member_chunk=member_chunk,
+        mesh=mesh,
+        round_to=round_to,
+        checkpoint_dir=checkpoint_dir,
+        cohort_fn=cohort_fn,
+        observe_fn=observe_fn,
+    )

@@ -155,9 +155,12 @@ class SweepCheckpointer:
         # config keys added AFTER a snapshot format existed compare
         # against their historical default, so genuine pre-upgrade
         # snapshots stay resumable instead of being refused for a key
-        # their writer couldn't have known about. momentum_dtype was
-        # added round 3; every earlier snapshot was written under f32.
+        # their writer couldn't have known about. momentum_dtype and
+        # init_unit_digest were added round 3; every earlier snapshot
+        # was written under f32 momentum and a self-sampled cohort.
         saved.setdefault("momentum_dtype", "float32")
+        if "init_unit_digest" in self.config:
+            saved.setdefault("init_unit_digest", None)
         if saved != self.config:
             # close before raising: callers only reach their own close()
             # via try/finally blocks entered AFTER a successful restore

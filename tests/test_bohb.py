@@ -32,7 +32,7 @@ def test_uniform_until_model_qualifies():
 
     # feed a discriminative history at budget 9: high scores cluster at
     # 0.2, low scores at 0.8 (every dim), well past n_min points
-    s = algo._store(9)
+    s = algo.obs.ring(9)
     rng = np.random.default_rng(0)
     n = max(4 * algo.n_min, 24)
     for i in range(n):
@@ -52,7 +52,7 @@ def test_uniform_until_model_qualifies():
 def test_model_prefers_highest_qualified_budget():
     algo = BOHB(_space(), seed=0, max_budget=27, eta=3)
     for b in (1, 3, 9):
-        s = algo._store(b)
+        s = algo.obs.ring(b)
         s["n"] = algo.n_min + 1
     assert algo._model_budget() == 9
 
@@ -88,6 +88,87 @@ def test_bohb_driver_loop_completes_and_uses_model():
     assert algo._model_budget() is not None
 
 
+def test_obsstore_drops_nan_scores():
+    """Diverged trials (NaN scores) must not enter the model or count
+    toward n_min — filtered in ObsStore.add so the host and fused paths
+    cannot disagree."""
+    from mpi_opt_tpu.algorithms.bohb import ObsStore
+
+    st = ObsStore(dim=2, buffer_size=4, n_min=2)
+    st.add(1, np.array([0.1, 0.2], np.float32), float("nan"))
+    assert 1 not in st.budgets  # nothing stored at all
+    st.add(1, np.array([0.1, 0.2], np.float32), 0.5)
+    st.add(1, np.array([0.3, 0.2], np.float32), 0.6)
+    assert st.model_budget() == 1
+
+
+def test_fused_hyperband_nan_bracket_never_sticks(monkeypatch):
+    """A diverged bracket (best_score NaN) must not freeze as the
+    overall winner — `x > nan` is False for every x, so the naive
+    best-pick would return the NaN bracket forever."""
+    import mpi_opt_tpu.train.fused_asha as fa
+
+    def fake(best):
+        return {
+            "best_score": best,
+            "best_params": {"marker": best},
+            "rung_sizes": [1],
+            "rung_budgets": [1],
+            "stop_rung": np.zeros(1, np.int32),
+            "last_score": np.array([best], np.float32),
+            "rung_history": [],
+            "n_trials": 1,
+        }
+
+    results = iter([fake(float("nan")), fake(0.9)])
+    monkeypatch.setattr(fa, "fused_sha", lambda *a, **k: next(results))
+    res = fa.fused_hyperband(None, max_budget=3, eta=3, seed=0)  # 2 brackets
+    assert res["best_score"] == pytest.approx(0.9)
+
+
+def test_fused_bohb_runs_and_uses_model():
+    """Fused BOHB: every bracket executes as a fused on-device SHA; by
+    the later brackets the model store has qualified, so cohorts carry
+    model-sampled rows (random_fraction=0 makes the count exact)."""
+    from mpi_opt_tpu.train.fused_bohb import fused_bohb
+
+    wl = get_workload("fashion_mlp", n_train=512, n_val=256)
+    # bracket 0's first rung alone contributes 9 observations at budget
+    # 1 (the FULL cohort scores, not just stop-rung ones), clearing the
+    # 5-dim space's default n_min=7 — so the model qualifies for every
+    # later bracket, same as the host algorithm would
+    res = fused_bohb(wl, max_budget=9, eta=3, seed=0, random_fraction=0.0)
+    # R=9: brackets (9@1, 5@3, 3@9) from bracket_plan
+    assert res["n_trials"] == 9 + 5 + 3
+    assert 0.0 <= res["best_score"] <= 1.0
+    assert res["brackets"][0]["n_model_sampled"] == 0  # nothing to fit yet
+    assert res["brackets"][1]["n_model_sampled"] == 5
+    assert res["brackets"][2]["n_model_sampled"] == 3
+
+
+def test_fused_sha_init_unit_digest_guards_resume(tmp_path):
+    """A fused SHA resumed under DIFFERENT initial configurations is a
+    different search: the checkpoint's cohort digest must refuse it."""
+    import jax
+
+    from mpi_opt_tpu.train.fused_asha import fused_sha
+
+    wl = get_workload("fashion_mlp", n_train=512, n_val=256)
+    space = wl.default_space()
+    ck = str(tmp_path / "ck")
+    unit_a = np.asarray(space.sample_unit(jax.random.key(1), 6))
+    fused_sha(wl, n_trials=6, min_budget=2, max_budget=6, eta=3,
+              seed=0, checkpoint_dir=ck, init_unit=unit_a)
+    unit_b = np.asarray(space.sample_unit(jax.random.key(2), 6))
+    with pytest.raises(ValueError, match="different sweep"):
+        fused_sha(wl, n_trials=6, min_budget=2, max_budget=6, eta=3,
+                  seed=0, checkpoint_dir=ck, init_unit=unit_b)
+    # the SAME cohort resumes fine (replays from the final snapshot)
+    res = fused_sha(wl, n_trials=6, min_budget=2, max_budget=6, eta=3,
+                    seed=0, checkpoint_dir=ck, init_unit=unit_a)
+    assert 0.0 <= res["best_score"] <= 1.0
+
+
 def test_bohb_checkpoint_roundtrip():
     wl = get_workload("quadratic")
     space = wl.default_space()
@@ -99,9 +180,9 @@ def test_bohb_checkpoint_roundtrip():
         resumed = BOHB(space, seed=3, max_budget=27, eta=3)
         resumed.load_state_dict(mid)
         assert resumed._samples == algo._samples
-        for b in algo._obs:
-            np.testing.assert_array_equal(resumed._obs[b]["unit"], algo._obs[b]["unit"])
-            assert resumed._obs[b]["n"] == algo._obs[b]["n"]
+        for b in algo.obs.budgets:
+            np.testing.assert_array_equal(resumed.obs.budgets[b]["unit"], algo.obs.budgets[b]["unit"])
+            assert resumed.obs.budgets[b]["n"] == algo.obs.budgets[b]["n"]
         r1 = run_search(algo, be)
         be.reset()
         r2 = run_search(resumed, be)
