@@ -227,9 +227,16 @@ def fused_sha(
             snap.close()
 
     np_unit = np.asarray(unit)
-    best_row = int(np.asarray(scores).argmax())
+    final_scores = np.asarray(scores)
+    # nanargmax: one diverged survivor must not hijack the bracket's
+    # best (argmax returns the NaN row) — only the all-NaN cohort
+    # reports NaN, which upstream best-picks treat as -inf
+    if np.isnan(final_scores).all():
+        best_row = 0
+    else:
+        best_row = int(np.nanargmax(final_scores))
     return {
-        "best_score": float(np.asarray(scores)[best_row]),
+        "best_score": float(final_scores[best_row]),
         "best_params": space.materialize_row(np_unit[best_row]),
         "best_trial": int(alive[best_row]),
         "rung_budgets": rungs,

@@ -74,9 +74,14 @@ def fused_bohb(
         if n_model == 0:
             return uniform, 0
         s = obs.budgets[budget]
-        # one batched, diversified acquisition call for the whole cohort
+        # one batched, diversified acquisition call for the whole cohort.
+        # n_suggest is STATIC under jit: requesting the deterministic
+        # bracket size n (not the random n_model) keeps the compile
+        # count bounded by the fixed bracket plan and cache-stable
+        # across runs/resumes; the first n_model rows are used (the
+        # batch is diversified, so any prefix is a valid draw set)
         sugg, _ = suggest(
-            k_model, s["unit"], s["score"], s["valid"], n_suggest=n_model, cfg=cfg
+            k_model, s["unit"], s["score"], s["valid"], n_suggest=n, cfg=cfg
         )
         cohort = uniform
         cohort[from_model] = np.asarray(sugg)[:n_model]
