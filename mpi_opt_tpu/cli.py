@@ -75,10 +75,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--fused",
         action="store_true",
-        help="run the whole sweep on-device (pbt/asha/hyperband/tpe): no "
-        "driver round-trips, population never leaves the device; "
-        "--checkpoint-dir makes it crash-recoverable (pbt: launch "
-        "granularity, asha/hyperband: rung granularity)",
+        help="run the whole sweep on-device (random/pbt/asha/hyperband/"
+        "bohb/tpe): no driver round-trips, population never leaves the "
+        "device; --checkpoint-dir makes it crash-recoverable (pbt: "
+        "launch granularity, asha/hyperband/bohb: rung granularity, "
+        "tpe: generation granularity)",
     )
     p.add_argument(
         "--member-chunk",
@@ -241,14 +242,21 @@ def run_fused(args, parser, workload) -> int:
             )
             n_trials = args.population * args.generations
             extra = {"best_curve": [round(float(v), 4) for v in res["best_curve"]]}
-        elif args.algorithm == "asha":
+        elif args.algorithm in ("asha", "random"):
             from mpi_opt_tpu.train.fused_asha import fused_sha
 
+            # fused random search IS the single-rung case of fused SHA:
+            # one cohort of --trials members trains to --budget in
+            # lockstep, no cuts — so one code path serves both
+            if args.algorithm == "random":
+                lo = hi = args.budget
+            else:
+                lo, hi = args.min_budget, args.max_budget
             res = fused_sha(
                 workload,
                 n_trials=args.trials,
-                min_budget=args.min_budget,
-                max_budget=args.max_budget,
+                min_budget=lo,
+                max_budget=hi,
                 eta=args.eta,
                 seed=args.seed,
                 member_chunk=args.member_chunk,
@@ -301,8 +309,14 @@ def run_fused(args, parser, workload) -> int:
             n_trials = res["n_trials"]
             extra = {"brackets": res["brackets"]}
         else:
+            # registry-drift guard: unreachable while every registered
+            # algorithm has a fused branch above (argparse's choices
+            # rejects unknown names first); a NEW algorithm added to the
+            # registry without fused support lands here with a clear
+            # error instead of an UnboundLocalError
             parser.error(
-                f"--fused supports pbt/asha/hyperband/bohb/tpe, not {args.algorithm!r}"
+                f"--fused supports random/pbt/asha/hyperband/bohb/tpe, "
+                f"not {args.algorithm!r}"
             )
     wall = time.perf_counter() - t0
     metrics.count_trials(n_trials)
