@@ -95,6 +95,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="fused pbt: generations per program launch (bit-identical "
         "split; needed where single programs are time-limited)",
     )
+    p.add_argument(
+        "--step-chunk",
+        type=int,
+        default=0,
+        help="fused pbt: max training steps per launch WITHIN a "
+        "generation (for populations whose single-generation program "
+        "exceeds the platform's execution window; deterministic, "
+        "checkpoint-guarded, not bit-identical to unchunked)",
+    )
     # mesh / multi-chip (SURVEY.md §2 row 9: the communication layer,
     # reachable from the user surface)
     p.add_argument(
@@ -237,6 +246,7 @@ def run_fused(args, parser, workload) -> int:
                 mesh=mesh,
                 member_chunk=args.member_chunk,
                 gen_chunk=args.gen_chunk,
+                step_chunk=args.step_chunk,
                 checkpoint_dir=args.checkpoint_dir,
                 snapshot_every=args.checkpoint_every,
             )

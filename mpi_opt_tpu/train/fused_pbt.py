@@ -86,6 +86,84 @@ def run_fused_pbt(
     return state, unit, key, best, mean, gen_scores[-1]
 
 
+def _balanced_split(total: int, chunk: int) -> list[int]:
+    """Split ``total`` into ceil(total/chunk) near-equal parts (lengths
+    differ by at most 1, so at most two distinct compiled program
+    lengths exist). Shared by gen_chunk (generations per launch) and
+    step_chunk (steps per sub-launch); total=0 yields [0] — one empty
+    part, matching the unchunked path's empty-scan behavior."""
+    if total <= 0:
+        return [0]
+    n_parts = -(-total // chunk)
+    base, rem = divmod(total, n_parts)
+    return [base + 1] * rem + [base] * (n_parts - rem)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("trainer", "discrete_mask", "cfg"),
+    donate_argnames=("state", "unit"),
+)
+def finish_generation(
+    trainer: PopulationTrainer,
+    state: PopState,
+    unit: jax.Array,
+    key: jax.Array,  # the generation's PBT key
+    val_x: jax.Array,
+    val_y: jax.Array,
+    discrete_mask: tuple = (),
+    cfg: PBTConfig = PBTConfig(),
+):
+    """The generation-boundary program for step-chunked sweeps: eval the
+    population, run exploit/explore, gather winner states — the tail of
+    ``run_fused_pbt.one_generation`` without the training scan (which
+    ran as separate ``train_segment`` launches). Returns
+    (state, unit, best, mean, post_exploit_scores)."""
+    disc = jnp.asarray(discrete_mask, dtype=bool)
+    scores = trainer.eval_population(state, val_x, val_y)
+    new_u, src_idx, _ = pbt_exploit_explore(key, unit, scores, disc, cfg)
+    state = trainer.gather_members(state, src_idx)
+    return state, new_u, scores.max(), scores.mean(), scores[src_idx]
+
+
+def _run_stepped_generation(
+    trainer,
+    state,
+    unit,
+    hparams_fn,
+    train_x,
+    train_y,
+    val_x,
+    val_y,
+    key,
+    disc,
+    steps: int,
+    step_chunk: int,
+    cfg: PBTConfig,
+):
+    """One PBT generation as ceil(steps/step_chunk) train launches plus
+    one boundary launch — the sub-generation analogue of gen_chunk, for
+    populations whose single-generation program exceeds a platform's
+    execution window (PERF_NOTES.md: pop=512 x 100 steps ~fills this
+    container's 60 s kill limit). Deterministic given (seed, step_chunk)
+    but NOT bit-identical to the unchunked scan: sub-segment RNG keys
+    are derived by folding the generation's train key, where the fused
+    scan threads one key through all ``steps``. Return shapes match one
+    ``run_fused_pbt(generations=1)`` launch.
+    """
+    key, k_train, k_pbt = jax.random.split(key, 3)
+    hp = hparams_fn(unit)
+    sub_lens = _balanced_split(steps, step_chunk)
+    for i, s in enumerate(sub_lens):
+        state, _ = trainer.train_segment(
+            state, hp, train_x, train_y, jax.random.fold_in(k_train, i), s
+        )
+    state, unit, best, mean, gen_scores = finish_generation(
+        trainer, state, unit, k_pbt, val_x, val_y, discrete_mask=disc, cfg=cfg
+    )
+    return state, unit, key, best[None], mean[None], gen_scores
+
+
 def fused_pbt(
     workload,
     population: int,
@@ -96,6 +174,7 @@ def fused_pbt(
     mesh=None,
     member_chunk: int = 0,
     gen_chunk: int = 0,
+    step_chunk: int = 0,
     checkpoint_dir: str = None,
     snapshot_every: int = 1,
     snapshot_last: bool = True,
@@ -135,6 +214,18 @@ def fused_pbt(
     buffers) is deliberate: the next launch donates the state buffers,
     which would invalidate them under orbax's background write.
 
+    ``step_chunk`` splits each GENERATION's training into
+    ceil(steps_per_gen/step_chunk) launches plus a boundary launch
+    (eval + exploit) — the sub-generation analogue of ``gen_chunk``,
+    needed when even ONE generation's program exceeds a platform's
+    execution window (PERF_NOTES.md "single-chip population envelope":
+    pop=512 x 100 steps ~fills this container's 60 s kill). Snapshots
+    stay generation-granular. Unlike gen_chunk it is deterministic but
+    NOT bit-identical to the unchunked sweep (sub-segment RNG keys are
+    folded, not threaded), so it is recorded in the checkpoint config
+    and a resume under a different step_chunk is refused. Mutually
+    exclusive with gen_chunk > 1.
+
     ``snapshot_last=False`` skips the unconditional final-launch save.
     The final snapshot is what makes a completed sweep re-runnable
     without recompute (tested), but a caller that consumes the returned
@@ -149,6 +240,11 @@ def fused_pbt(
 
     if generations < 1:  # before any data/device work
         raise ValueError(f"generations must be >= 1, got {generations}")
+    if step_chunk > 0 and gen_chunk > 1:
+        raise ValueError(
+            "step_chunk splits within generations; combining it with "
+            f"gen_chunk={gen_chunk} (grouping whole generations) is ambiguous"
+        )
     trainer, space, train_x, train_y, val_x, val_y = workload_arrays(
         workload, member_chunk, mesh
     )
@@ -156,13 +252,13 @@ def fused_pbt(
     k_init, k_unit, k_run = jax.random.split(key, 3)
 
     disc = tuple(bool(b) for b in space.discrete_mask())
+    if step_chunk > 0:
+        gen_chunk = 1  # every launch is (part of) exactly one generation
     g_chunk = generations if gen_chunk <= 0 else min(gen_chunk, generations)
-    # balanced split: ceil(G/chunk) launches whose lengths differ by at
-    # most 1 (e.g. G=3, chunk=2 -> [2, 1]; G=7, chunk=3 -> [3, 2, 2]),
-    # so a non-dividing chunk costs one extra compile, never more
-    n_launches = -(-generations // g_chunk)
-    base, rem = divmod(generations, n_launches)
-    launch_lens = [base + 1] * rem + [base] * (n_launches - rem)
+    # balanced split (e.g. G=3, chunk=2 -> [2, 1]; G=7, chunk=3 ->
+    # [3, 2, 2]): a non-dividing chunk costs one extra compile, never more
+    launch_lens = _balanced_split(generations, g_chunk)
+    n_launches = len(launch_lens)
 
     # restore BEFORE initializing: a resumed sweep must not pay (or
     # transiently hold the memory of) a full-population init it discards
@@ -191,6 +287,10 @@ def fused_pbt(
                 # PBT knobs change exploit/explore behavior: resuming under
                 # a different cfg would not be the continuation we promise
                 "cfg": dataclasses.asdict(cfg),
+                # step_chunk changes the RNG derivation (folded sub-segment
+                # keys), i.e. the trajectory itself — not just the launch
+                # split the way gen_chunk does
+                "step_chunk": step_chunk,
                 # the momentum STORAGE dtype is part of the carried state's
                 # structure: resuming a bf16-momentum snapshot into an f32
                 # trainer would crash in the scan carry (or silently change
@@ -238,23 +338,43 @@ def fused_pbt(
     try:
         for i in range(start_launch, n_launches):
             t_launch = time.perf_counter()
-            # k_run is the scan-carried key returned by the previous
-            # launch: the chain continues exactly as one longer scan would
-            state, unit, k_run, best, mean, final_scores = run_fused_pbt(
-                trainer,
-                state,
-                unit,
-                hparams_fn,
-                train_x=train_x,
-                train_y=train_y,
-                val_x=val_x,
-                val_y=val_y,
-                key=k_run,
-                discrete_mask=disc,
-                generations=launch_lens[i],
-                steps_per_gen=steps_per_gen,
-                cfg=cfg,
-            )
+            if step_chunk > 0:
+                # one generation as k sub-segment launches + a boundary
+                # launch; the carried key advances exactly once per gen
+                state, unit, k_run, best, mean, final_scores = _run_stepped_generation(
+                    trainer,
+                    state,
+                    unit,
+                    hparams_fn,
+                    train_x,
+                    train_y,
+                    val_x,
+                    val_y,
+                    k_run,
+                    disc,
+                    steps_per_gen,
+                    step_chunk,
+                    cfg,
+                )
+            else:
+                # k_run is the scan-carried key returned by the previous
+                # launch: the chain continues exactly as one longer scan
+                # would
+                state, unit, k_run, best, mean, final_scores = run_fused_pbt(
+                    trainer,
+                    state,
+                    unit,
+                    hparams_fn,
+                    train_x=train_x,
+                    train_y=train_y,
+                    val_x=val_x,
+                    val_y=val_y,
+                    key=k_run,
+                    discrete_mask=disc,
+                    generations=launch_lens[i],
+                    steps_per_gen=steps_per_gen,
+                    cfg=cfg,
+                )
             # curves to host eagerly: they are tiny, and a later crash
             # must not lose completed launches' history
             best_parts.append(np.asarray(best))

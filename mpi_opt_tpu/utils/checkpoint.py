@@ -161,6 +161,8 @@ class SweepCheckpointer:
         saved.setdefault("momentum_dtype", "float32")
         if "init_unit_digest" in self.config:
             saved.setdefault("init_unit_digest", None)
+        if "step_chunk" in self.config:
+            saved.setdefault("step_chunk", 0)  # pre-upgrade sweeps were unchunked
         if saved != self.config:
             # close before raising: callers only reach their own close()
             # via try/finally blocks entered AFTER a successful restore

@@ -117,6 +117,80 @@ def test_resume_after_completion_skips_all_launches(tmp_path, monkeypatch):
     assert again["best_score"] == first["best_score"]
 
 
+def test_step_chunk_deterministic_learns_and_matches_shapes():
+    """step_chunk (sub-generation launch splitting) is deterministic,
+    returns the same result shapes as the fused scan, and still learns.
+    It is NOT bit-identical to the unchunked sweep (documented: folded
+    sub-segment keys), so equality is asserted between two step-chunked
+    runs, not against the scan."""
+    wl = _wl()
+    kw = dict(population=8, generations=3, steps_per_gen=6, seed=5, step_chunk=2)
+    a = fp.fused_pbt(wl, **kw)
+    b = fp.fused_pbt(wl, **kw)
+    np.testing.assert_array_equal(a["best_curve"], b["best_curve"])
+    assert a["best_score"] == b["best_score"]
+    assert len(a["best_curve"]) == 3
+    assert a["launch_gens"] == [1, 1, 1]
+    assert len(a["launch_walls"]) == 3
+    # shapes/semantics match the scan path's result contract
+    scan = fp.fused_pbt(wl, population=8, generations=3, steps_per_gen=6, seed=5)
+    assert set(a.keys()) == set(scan.keys())
+
+
+def test_step_chunk_crash_resume_identical(tmp_path, monkeypatch):
+    """Generation-granular snapshots make a killed step-chunked sweep
+    resume to the identical result of an uninterrupted one."""
+    wl = _wl()
+    kw = dict(population=8, generations=4, steps_per_gen=6, seed=6, step_chunk=3)
+    whole = fp.fused_pbt(wl, **kw)
+
+    real = fp._run_stepped_generation
+    calls = {"n": 0}
+
+    def crashing(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise RuntimeError("simulated TPU worker crash")
+        return real(*a, **k)
+
+    ckpt = str(tmp_path / "ck")
+    monkeypatch.setattr(fp, "_run_stepped_generation", crashing)
+    with pytest.raises(RuntimeError, match="simulated"):
+        fp.fused_pbt(wl, checkpoint_dir=ckpt, **kw)
+    monkeypatch.setattr(fp, "_run_stepped_generation", real)
+    resumed = fp.fused_pbt(wl, checkpoint_dir=ckpt, **kw)
+    np.testing.assert_array_equal(resumed["best_curve"], whole["best_curve"])
+    assert resumed["best_score"] == whole["best_score"]
+
+
+def test_step_chunk_changes_trajectory_and_guards_resume(tmp_path):
+    """step_chunk is part of the checkpoint config: it changes the RNG
+    derivation (a different search trajectory), so resuming an
+    unchunked snapshot with step_chunk set must be refused."""
+    wl = _wl()
+    ckpt = str(tmp_path / "ck")
+    fp.fused_pbt(wl, checkpoint_dir=ckpt, **KW)
+    with pytest.raises(ValueError, match="different sweep"):
+        fp.fused_pbt(wl, checkpoint_dir=ckpt, step_chunk=2, **KW)
+
+
+def test_step_chunk_accepts_zero_steps_like_unchunked():
+    """Degenerate steps_per_gen=0 (eval/exploit only) must behave the
+    same chunked and unchunked — regression: the split once divided by
+    zero for total=0."""
+    wl = _wl()
+    res = fp.fused_pbt(wl, population=4, generations=2, steps_per_gen=0, step_chunk=2)
+    assert len(res["best_curve"]) == 2
+
+
+def test_step_chunk_rejects_gen_chunk_combination():
+    wl = _wl()
+    with pytest.raises(ValueError, match="ambiguous"):
+        fp.fused_pbt(
+            wl, population=4, generations=4, steps_per_gen=4, gen_chunk=2, step_chunk=2
+        )
+
+
 def test_snapshot_last_false_skips_final_save(tmp_path):
     """A bench-style caller consumes the result immediately; the final
     launch's snapshot (a multi-GB, minutes-long host fetch at ResNet
