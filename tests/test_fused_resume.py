@@ -174,6 +174,27 @@ def test_step_chunk_changes_trajectory_and_guards_resume(tmp_path):
         fp.fused_pbt(wl, checkpoint_dir=ckpt, step_chunk=2, **KW)
 
 
+def test_step_chunk_on_mesh_keeps_pop_sharding():
+    """step_chunk adds host-side launch boundaries inside a generation;
+    the population must stay sharded over 'pop' across them (XLA output
+    shardings propagate through train sub-launches AND the boundary
+    program's exploit gather) — a silent fallback to replication would
+    defeat the mesh without failing any correctness check."""
+    import jax
+
+    from mpi_opt_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(n_pop=8, n_data=1)
+    wl = _wl()
+    res = fp.fused_pbt(
+        wl, population=8, generations=2, steps_per_gen=4, seed=0,
+        step_chunk=2, mesh=mesh,
+    )
+    for leaf in jax.tree.leaves(res["state"].params):
+        assert not leaf.sharding.is_fully_replicated, leaf.sharding
+    assert 0.0 <= res["best_score"] <= 1.0
+
+
 def test_step_chunk_accepts_zero_steps_like_unchunked():
     """Degenerate steps_per_gen=0 (eval/exploit only) must behave the
     same chunked and unchunked — regression: the split once divided by
