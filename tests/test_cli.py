@@ -104,6 +104,41 @@ def test_fused_rejects_non_population_workload():
         main(["--workload", "digits", "--algorithm", "pbt", "--fused"])
 
 
+def test_fused_pbt_step_chunk_cli(capsys, monkeypatch):
+    """--step-chunk actually reaches fused_pbt (a dropped kwarg would
+    run unchunked and every summary assertion would still pass, so the
+    plumbing is asserted directly) and the sweep completes."""
+    import mpi_opt_tpu.train.fused_pbt as fpbt
+
+    seen = {}
+    real = fpbt.fused_pbt
+
+    def spying(workload, **kw):
+        seen.update(kw)
+        return real(workload, **kw)
+
+    monkeypatch.setattr(fpbt, "fused_pbt", spying)
+    rc = main(
+        [
+            "--workload", "fashion_mlp",
+            "--algorithm", "pbt",
+            "--fused",
+            "--population", "4",
+            "--generations", "2",
+            "--steps-per-generation", "4",
+            "--step-chunk", "2",
+            "--seed", "0",
+        ]
+    )
+    assert rc == 0
+    assert seen["step_chunk"] == 2
+    summary = _summary(capsys)
+    assert summary["backend"] == "fused"
+    assert summary["n_trials"] == 8
+    assert len(summary["best_curve"]) == 2
+    assert 0.0 <= summary["best_score"] <= 1.0
+
+
 def test_fused_random_cli(capsys):
     """Fused random search = the single-rung case of fused SHA: one
     cohort trains to --budget in lockstep, no cuts."""
