@@ -27,6 +27,26 @@ from mpi_opt_tpu.space import SearchSpace
 from mpi_opt_tpu.trial import Trial, TrialResult, TrialStatus
 
 
+def best_finite(items, key):
+    """The item with the highest FINITE key, else the first item.
+
+    The one best-pick rule, shared by Algorithm.best, Hyperband.best and
+    the fused bracket loop so host and fused paths cannot drift: a
+    diverged trial's score (NaN, or +/-inf from an exploded loss) never
+    wins — Python's max never displaces a NaN front-runner (`x > nan`
+    is False) and +inf would beat every real score — matching the
+    isfinite gate BOHB's ObsStore applies to model inputs. Only an
+    all-diverged item set returns a diverged item (the first), so
+    callers still see that *something* ran, with the non-finite key
+    left visible as the flag. Returns None for an empty item list.
+    """
+    items = list(items)
+    finite = [it for it in items if np.isfinite(key(it))]
+    if finite:
+        return max(finite, key=key)
+    return items[0] if items else None
+
+
 class Algorithm(abc.ABC):
     """Base class for search algorithms.
 
@@ -103,7 +123,7 @@ class Algorithm(abc.ABC):
 
     def best(self) -> Optional[Trial]:
         scored = [t for t in self.trials.values() if t.score is not None]
-        return max(scored, key=lambda t: t.score) if scored else None
+        return best_finite(scored, key=lambda t: t.score)
 
     @property
     def n_trials(self) -> int:

@@ -39,6 +39,15 @@ from mpi_opt_tpu.space import SearchSpace
 from mpi_opt_tpu.trial import TrialResult
 
 
+def default_n_min(dim: int) -> int:
+    """The paper's model-fit gate (Falkner et al. 2018 §3.1): N_min =
+    d+1, and a KDE is fit once N_min + 2 = d + 3 observations exist at
+    a budget (both the good and bad KDEs need points). Single-sourced:
+    the host algorithm and the fused sweep both call this, so the
+    qualification rule cannot drift between them."""
+    return dim + 3
+
+
 class ObsStore:
     """Per-budget ring buffers of (unit, score) observations plus the
     highest-qualified-budget rule — BOHB's model bookkeeping, shared by
@@ -62,10 +71,11 @@ class ObsStore:
         return self.budgets[budget]
 
     def add(self, budget: int, unit: np.ndarray, score: float) -> None:
-        # NaN scores (diverged trials) never enter the model: they would
-        # count toward n_min qualification and poison the KDE split.
+        # Non-finite scores (diverged trials: NaN, or +/-inf from an
+        # exploded loss) never enter the model: they would count toward
+        # n_min qualification and poison the KDE moments/bandwidths.
         # Filtered HERE so the host and fused paths cannot disagree.
-        if np.isnan(score):
+        if not np.isfinite(score):
             return
         s = self.ring(int(budget))
         slot = s["n"] % self.buffer_size
@@ -139,8 +149,7 @@ class BOHB(Hyperband):
         self.random_fraction = random_fraction
         self.config = config
         self.buffer_size = buffer_size
-        # the paper's minimum: d+2 observations before a KDE is fit
-        self.n_min = n_min if n_min is not None else space.dim + 2
+        self.n_min = n_min if n_min is not None else default_n_min(space.dim)
         self.obs = ObsStore(space.dim, buffer_size, self.n_min)
         self._samples = 0  # fold-in counter for model/uniform draws
         super().__init__(space, seed=seed, max_budget=max_budget, eta=eta)
@@ -192,6 +201,11 @@ class BOHB(Hyperband):
         return d
 
     def load_state_dict(self, state):
+        # a checkpoint written by plain hyperband has no model state;
+        # refuse it with the same clear ValueError the R/eta and
+        # buffer-size mismatches raise, not a bare KeyError
+        if "bohb" not in state:
+            raise ValueError("checkpoint is for hyperband, not bohb")
         b = state["bohb"]
         # validate BEFORE any mutation (matching Hyperband's R/eta
         # check): ring slot arithmetic (n % buffer_size) silently

@@ -23,7 +23,7 @@ from typing import Sequence
 import numpy as np
 
 from mpi_opt_tpu.algorithms.asha import ASHA
-from mpi_opt_tpu.algorithms.base import Algorithm
+from mpi_opt_tpu.algorithms.base import Algorithm, best_finite
 from mpi_opt_tpu.space import SearchSpace
 from mpi_opt_tpu.trial import TrialResult
 
@@ -104,9 +104,10 @@ class Hyperband(Algorithm):
     # -- aggregation across brackets --------------------------------------
 
     def best(self):
+        # a bracket whose trials ALL diverged reports a non-finite best;
+        # the cross-bracket pick applies the same rule as within brackets
         bests = [b.best() for b in self.brackets]
-        bests = [t for t in bests if t is not None]
-        return max(bests, key=lambda t: t.score) if bests else None
+        return best_finite([t for t in bests if t is not None], key=lambda t: t.score)
 
     @property
     def n_trials(self) -> int:

@@ -339,10 +339,16 @@ def run_fused(args, parser, workload) -> int:
         "n_trials": n_trials,
         "wall_s": round(wall, 3),
         "trials_per_sec_per_chip": round(n_trials / max(wall, 1e-9) / n_chips, 4),
-        "best_score": round(res["best_score"], 6),
-        "best_params": {
-            k: v for k, v in res["best_params"].items() if not k.startswith("__")
-        },
+        # best_params is None when the whole sweep diverged (all scores
+        # non-finite) — mirror the driver path's no-best summary shape,
+        # including best_score: null (json.dumps would otherwise emit
+        # the non-standard NaN token and break strict parsers)
+        "best_score": None
+        if res["best_params"] is None
+        else round(res["best_score"], 6),
+        "best_params": None
+        if res["best_params"] is None
+        else {k: v for k, v in res["best_params"].items() if not k.startswith("__")},
         **extra,
     }
     metrics.summary(**{"final": True})

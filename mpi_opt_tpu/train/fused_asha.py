@@ -228,17 +228,19 @@ def fused_sha(
 
     np_unit = np.asarray(unit)
     final_scores = np.asarray(scores)
-    # nanargmax: one diverged survivor must not hijack the bracket's
-    # best (argmax returns the NaN row) — only the all-NaN cohort
-    # reports NaN, which upstream best-picks treat as -inf
-    if np.isnan(final_scores).all():
-        best_row = 0
-    else:
-        best_row = int(np.nanargmax(final_scores))
+    # one diverged survivor (NaN, or +/-inf from an exploded loss) must
+    # not hijack the bracket's best — argmax would return the NaN/+inf
+    # row. Same isfinite rule as the host path's best_finite; the
+    # all-diverged cohort reports non-finite/None with diverged=True,
+    # so no arbitrary row masquerades as a meaningful winner
+    finite = np.isfinite(final_scores)
+    diverged = not bool(finite.any())
+    best_row = 0 if diverged else int(np.where(finite, final_scores, -np.inf).argmax())
     return {
         "best_score": float(final_scores[best_row]),
-        "best_params": space.materialize_row(np_unit[best_row]),
-        "best_trial": int(alive[best_row]),
+        "best_params": None if diverged else space.materialize_row(np_unit[best_row]),
+        "best_trial": None if diverged else int(alive[best_row]),
+        "diverged": diverged,
         "rung_budgets": rungs,
         "rung_sizes": sizes,
         "stop_rung": stop_rung,
@@ -246,6 +248,53 @@ def fused_sha(
         "rung_history": rung_history,
         "n_trials": n_trials,
     }
+
+
+def _bracket_cohort(checkpoint_dir, b: int, n: int, tag: str, cohort_fn):
+    """Sample bracket ``b``'s initial cohort — durably, when the sweep
+    is checkpointed. The sampled matrix is persisted next to the
+    bracket snapshots and REUSED on resume: regenerating it would
+    couple resume correctness to bit-identical model-sampling replay
+    across processes/JAX versions, where any numeric drift makes
+    fused_sha's cohort digest permanently refuse an otherwise-valid
+    checkpoint with no recovery path (ADVICE r3). The digest check
+    stays as defense-in-depth — the persisted cohort always matches it.
+    """
+    import os
+
+    path = None
+    if checkpoint_dir:
+        path = os.path.join(checkpoint_dir, f"cohort_{b}.npz")
+        if os.path.exists(path):
+            with np.load(path) as z:
+                cohort, n_model = np.array(z["cohort"]), int(z["n_model"])
+                saved_tag = str(z["tag"])
+            # validated HERE, not only by fused_sha's snapshot config
+            # check: a crash after the cohort write but before the first
+            # rung snapshot leaves no snapshot to refuse a reused dir,
+            # so the cohort file itself carries the sweep's identity
+            # (workload/plan/seed tag + row count). The cohort's VALUES
+            # are deliberately not part of the identity — the persisted
+            # matrix IS the sweep's sampling record; model hyperparams
+            # (random_fraction, TPEConfig) only shaped how it was drawn.
+            if cohort.shape[0] != n or saved_tag != tag:
+                raise ValueError(
+                    f"persisted cohort for bracket {b} is from a different "
+                    f"sweep ({cohort.shape[0]} rows, tag {saved_tag!r}; "
+                    f"expected {n} rows, tag {tag!r}) — use a fresh "
+                    "checkpoint dir"
+                )
+            return cohort, n_model
+    cohort, n_model = cohort_fn(b, n)
+    if path is not None:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        # write-then-rename: a crash mid-write must not leave a torn
+        # cohort file that a resume would trust
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, cohort=cohort, n_model=n_model, tag=np.asarray(tag))
+        os.replace(tmp, path)
+    return cohort, n_model
 
 
 def fused_hyperband(
@@ -284,13 +333,23 @@ def fused_hyperband(
     """
     import os
 
+    from mpi_opt_tpu.algorithms.base import best_finite
     from mpi_opt_tpu.algorithms.hyperband import bracket_plan
 
     best = None
     brackets = []
     n_total = 0
+    # the persisted-cohort identity: workload + bracket plan + seed
+    # (everything that determines which search the cohorts belong to)
+    tag = (
+        f"{getattr(workload, 'name', type(workload).__name__)}"
+        f"|R={max_budget}|eta={eta}|seed={seed}"
+    )
     for b, (n, r) in enumerate(bracket_plan(max_budget, eta)):
-        cohort, n_model = (None, None) if cohort_fn is None else cohort_fn(b, n)
+        if cohort_fn is None:
+            cohort, n_model = None, None
+        else:
+            cohort, n_model = _bracket_cohort(checkpoint_dir, b, n, tag, cohort_fn)
         res = fused_sha(
             workload,
             n_trials=n,
@@ -320,14 +379,14 @@ def fused_hyperband(
         if cohort_fn is not None:
             summary["n_model_sampled"] = n_model
         brackets.append(summary)
-        # NaN-safe best-pick: a diverged bracket (best_score NaN) must
-        # never stick — `x > nan` is False for every x, so the naive
-        # comparison would freeze the NaN as the winner forever
-        score = res["best_score"]
-        score = float("-inf") if np.isnan(score) else score
-        best_sc = float("-inf") if best is None or np.isnan(best["best_score"]) else best["best_score"]
-        if best is None or score > best_sc:
+        # diverged brackets (non-finite best_score) never stick as the
+        # overall winner — the ONE best-pick rule, shared with the host
+        # path (see algorithms.base.best_finite); pairwise fold keeps
+        # the first bracket when everything diverged
+        if best is None:
             best = res
+        else:
+            best = best_finite([best, res], key=lambda r: r["best_score"])
     return {
         "best_score": best["best_score"],
         "best_params": best["best_params"],

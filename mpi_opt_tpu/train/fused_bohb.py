@@ -18,11 +18,11 @@ rungs contributes three observations at three budgets — the same
 observation set the host algorithm's ``report_batch`` accumulates.
 
 Crash recovery: brackets checkpoint individually (rung granularity,
-``bracket_b`` subdirectories). The model's inputs are the completed
-brackets' results, which replay bit-identically from their snapshots,
-and the sampling keys are deterministic — so a resumed fused BOHB
-regenerates the SAME initial cohorts (fused_sha additionally records a
-digest of each cohort and refuses a mismatch).
+``bracket_b`` subdirectories), and each bracket's sampled cohort is
+PERSISTED (``cohort_b.npz``, via ``fused_hyperband``'s bracket loop)
+and reused on resume — resume correctness never depends on the model
+regenerating bit-identical samples across processes/JAX versions.
+fused_sha's cohort digest stays as defense-in-depth.
 """
 
 from __future__ import annotations
@@ -30,7 +30,7 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from mpi_opt_tpu.algorithms.bohb import ObsStore
+from mpi_opt_tpu.algorithms.bohb import ObsStore, default_n_min
 from mpi_opt_tpu.ops.tpe import TPEConfig, tpe_suggest
 from mpi_opt_tpu.train.common import workload_arrays
 from mpi_opt_tpu.train.fused_asha import fused_hyperband
@@ -54,7 +54,7 @@ def fused_bohb(
     how many of each cohort came from the model vs uniform)."""
     _, space, *_ = workload_arrays(workload, member_chunk, mesh)
     if n_min is None:
-        n_min = space.dim + 2
+        n_min = default_n_min(space.dim)
     obs = ObsStore(space.dim, buffer_size, n_min)
     suggest = jax.jit(tpe_suggest, static_argnames=("n_suggest", "cfg"))
 

@@ -223,3 +223,44 @@ def test_tpe_clamps_oversized_batch(workload):
     assert 0 < len(batch) <= 8
     algo.report_batch(b.evaluate(batch))
     b.close()
+
+
+def test_best_ignores_nan_scores(workload):
+    """A diverged (NaN) trial reported FIRST must not hijack best():
+    Python's max never displaces a NaN front-runner (`x > nan` is
+    False), so the naive pick would return it forever (VERDICT r3)."""
+    from mpi_opt_tpu.trial import TrialResult
+
+    algo = RandomSearch(workload.default_space(), seed=0, max_trials=3, budget=1)
+    ts = algo.next_batch(3)
+    algo.report_batch([TrialResult(ts[0].trial_id, score=float("nan"), step=1)])
+    algo.report_batch([TrialResult(ts[1].trial_id, score=0.3, step=1)])
+    algo.report_batch([TrialResult(ts[2].trial_id, score=0.7, step=1)])
+    best = algo.best()
+    assert best.trial_id == ts[2].trial_id
+    assert best.score == pytest.approx(0.7)
+
+
+def test_best_all_nan_returns_diverged_trial(workload):
+    """Only an all-NaN search may return a NaN best — callers can then
+    see that something ran and that it diverged."""
+    from mpi_opt_tpu.trial import TrialResult
+
+    algo = RandomSearch(workload.default_space(), seed=0, max_trials=2, budget=1)
+    ts = algo.next_batch(2)
+    algo.report_batch([TrialResult(t.trial_id, score=float("nan"), step=1) for t in ts])
+    best = algo.best()
+    assert best is not None and np.isnan(best.score)
+
+
+def test_best_ignores_inf_scores(workload):
+    """+inf (exploded negated loss) is as diverged as NaN and would beat
+    every real score under naive max — the isfinite gate must exclude it
+    too, matching BOHB ObsStore's model-input rule."""
+    from mpi_opt_tpu.trial import TrialResult
+
+    algo = RandomSearch(workload.default_space(), seed=0, max_trials=2, budget=1)
+    ts = algo.next_batch(2)
+    algo.report_batch([TrialResult(ts[0].trial_id, score=float("inf"), step=1)])
+    algo.report_batch([TrialResult(ts[1].trial_id, score=0.4, step=1)])
+    assert algo.best().trial_id == ts[1].trial_id

@@ -135,8 +135,8 @@ def test_fused_bohb_runs_and_uses_model():
     wl = get_workload("fashion_mlp", n_train=512, n_val=256)
     # bracket 0's first rung alone contributes 9 observations at budget
     # 1 (the FULL cohort scores, not just stop-rung ones), clearing the
-    # 5-dim space's default n_min=7 — so the model qualifies for every
-    # later bracket, same as the host algorithm would
+    # 5-dim space's default n_min = d+3 = 8 — so the model qualifies for
+    # every later bracket, same as the host algorithm would
     res = fused_bohb(wl, max_budget=9, eta=3, seed=0, random_fraction=0.0)
     # R=9: brackets (9@1, 5@3, 3@9) from bracket_plan
     assert res["n_trials"] == 9 + 5 + 3
@@ -192,3 +192,82 @@ def test_bohb_checkpoint_roundtrip():
     # both complete the full plan (arrival-order effects can differ, as
     # with hyperband's resume; completion and a sane best are the contract)
     assert algo.finished() and resumed.finished()
+
+
+def test_obsstore_drops_inf_scores():
+    """+/-inf scores (exploded losses) are as model-poisoning as NaN:
+    they'd blow up the KDE moments/bandwidths. Same isfinite gate, same
+    single filtering point (ADVICE r3)."""
+    from mpi_opt_tpu.algorithms.bohb import ObsStore
+
+    st = ObsStore(dim=2, buffer_size=4, n_min=2)
+    st.add(1, np.array([0.1, 0.2], np.float32), float("inf"))
+    st.add(1, np.array([0.3, 0.4], np.float32), float("-inf"))
+    assert 1 not in st.budgets
+
+
+def test_bohb_refuses_hyperband_checkpoint():
+    """Restoring a plain-hyperband checkpoint into BOHB must be the
+    clear ValueError refusal the R/eta and buffer-size mismatches give,
+    not a bare KeyError (ADVICE r3)."""
+    space = _space()
+    hb_state = Hyperband(space, seed=0, max_budget=9, eta=3).state_dict()
+    algo = BOHB(space, seed=0, max_budget=9, eta=3)
+    with pytest.raises(ValueError, match="hyperband, not bohb"):
+        algo.load_state_dict(hb_state)
+
+
+def test_fused_hyperband_persists_cohorts_for_resume(tmp_path):
+    """Resume correctness must not depend on the model regenerating
+    bit-identical cohorts: each bracket's sampled cohort is persisted
+    (cohort_b.npz) and reused, so a resumed sweep whose sampler would
+    drift numerically still replays — the drifted sampler is never even
+    consulted (ADVICE r3)."""
+    import jax
+
+    from mpi_opt_tpu.train.fused_asha import fused_hyperband
+
+    wl = get_workload("fashion_mlp", n_train=512, n_val=256)
+    space = wl.default_space()
+    ck = str(tmp_path / "ck")
+
+    def cohort_a(b, n):
+        u = np.array(space.sample_unit(jax.random.fold_in(jax.random.key(7), b), n))
+        return u, 0
+
+    r1 = fused_hyperband(wl, max_budget=3, eta=3, seed=0,
+                         checkpoint_dir=ck, cohort_fn=cohort_a)
+
+    def cohort_drifted(b, n):
+        raise AssertionError("resume must reuse the persisted cohort, "
+                             "not regenerate it")
+
+    r2 = fused_hyperband(wl, max_budget=3, eta=3, seed=0,
+                         checkpoint_dir=ck, cohort_fn=cohort_drifted)
+    assert r2["best_score"] == pytest.approx(r1["best_score"])
+    assert r2["best_params"] == r1["best_params"]
+
+
+def test_persisted_cohort_refuses_different_sweep(tmp_path):
+    """A cohort file left by a crashed run of a DIFFERENT sweep (other
+    seed/workload/plan) must be refused even when no bracket snapshot
+    exists yet to trigger fused_sha's config check — the cohort npz
+    carries its own sweep-identity tag."""
+    from mpi_opt_tpu.train.fused_asha import _bracket_cohort
+
+    ck = str(tmp_path / "ck")
+
+    def cohort(b, n):
+        return np.full((n, 2), 0.5, np.float32), 0
+
+    tag_a = "fashion_mlp|R=9|eta=3|seed=0"
+    _bracket_cohort(ck, 0, 3, tag_a, cohort)  # first run writes cohort_0.npz
+    for other in ("fashion_mlp|R=9|eta=3|seed=1",   # different seed
+                  "cifar_cnn|R=9|eta=3|seed=0",      # different workload
+                  "fashion_mlp|R=27|eta=3|seed=0"):  # different plan
+        with pytest.raises(ValueError, match="different sweep"):
+            _bracket_cohort(ck, 0, 3, other, cohort)
+    # the matching sweep still reuses it, without consulting the sampler
+    c, m = _bracket_cohort(ck, 0, 3, tag_a,
+                           lambda b, n: (_ for _ in ()).throw(AssertionError))
+    assert c.shape == (3, 2) and m == 0

@@ -63,3 +63,38 @@ def test_fused_sha_sharded_matches_structure(workload):
     )
     assert r["rung_sizes"] == [8, 4]
     assert 0.0 <= r["best_score"] <= 1.0
+
+
+def test_fused_sha_all_nan_cohort_reports_diverged(monkeypatch):
+    """An all-diverged cohort must not dress an arbitrary row up as a
+    winner: best_params/best_trial are None and diverged=True, with the
+    NaN best_score left visible as the flag upstream best-picks key on
+    (ADVICE r3)."""
+    import jax.numpy as jnp
+
+    from mpi_opt_tpu.train.common import workload_arrays
+
+    wl = get_workload("fashion_mlp", n_train=256, n_val=128)
+    trainer, *_ = workload_arrays(wl)
+    monkeypatch.setattr(trainer, "eval_population", lambda *a, **k: jnp.full(4, jnp.nan))
+    r = fused_sha(wl, n_trials=4, min_budget=1, max_budget=1, eta=3, seed=0)
+    assert r["diverged"] is True
+    assert r["best_params"] is None and r["best_trial"] is None
+    assert np.isnan(r["best_score"])
+
+
+def test_fused_sha_one_nan_does_not_hijack(monkeypatch):
+    """One diverged member in an otherwise healthy cohort: the winner is
+    the best FINITE score, diverged stays False."""
+    import jax.numpy as jnp
+
+    from mpi_opt_tpu.train.common import workload_arrays
+
+    wl = get_workload("fashion_mlp", n_train=256, n_val=128)
+    trainer, *_ = workload_arrays(wl)
+    scores = jnp.asarray([jnp.nan, 0.2, 0.9, 0.4])
+    monkeypatch.setattr(trainer, "eval_population", lambda *a, **k: scores)
+    r = fused_sha(wl, n_trials=4, min_budget=1, max_budget=1, eta=3, seed=0)
+    assert r["diverged"] is False
+    assert r["best_trial"] == 2
+    assert r["best_score"] == pytest.approx(0.9)
