@@ -22,7 +22,7 @@ from typing import Sequence
 import jax
 import numpy as np
 
-from mpi_opt_tpu.algorithms.base import Algorithm
+from mpi_opt_tpu.algorithms.base import Algorithm, host_sampling
 from mpi_opt_tpu.ops.asha import asha_rungs
 from mpi_opt_tpu.space import SearchSpace
 from mpi_opt_tpu.trial import TrialResult, TrialStatus
@@ -65,13 +65,17 @@ class ASHA(Algorithm):
             t = self.trials[tid]
             t.status = TrialStatus.RUNNING
             out.append(t)
-        while len(out) < n and self._suggested < self.max_trials:
-            key = jax.random.fold_in(jax.random.key(self.seed), self._suggested)
-            unit = self._sample_fresh(key)
-            t = self._new_trial(unit, budget=self.rungs[0])
-            t.status = TrialStatus.RUNNING
-            out.append(t)
-            self._suggested += 1
+        # CPU-pinned sampling (host_sampling docstring: one-row samples
+        # on a tunneled default device dominated the whole search wall);
+        # also covers BOHB's model-sampling override of _sample_fresh
+        with host_sampling():
+            while len(out) < n and self._suggested < self.max_trials:
+                key = jax.random.fold_in(jax.random.key(self.seed), self._suggested)
+                unit = self._sample_fresh(key)
+                t = self._new_trial(unit, budget=self.rungs[0])
+                t.status = TrialStatus.RUNNING
+                out.append(t)
+                self._suggested += 1
         self._outstanding.update(t.trial_id for t in out)
         return out
 

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mpi_opt_tpu.algorithms.base import Algorithm
+from mpi_opt_tpu.algorithms.base import Algorithm, host_sampling
 from mpi_opt_tpu.ops.pbt import PBTConfig, pbt_exploit_explore
 from mpi_opt_tpu.space import SearchSpace
 from mpi_opt_tpu.trial import TrialResult, TrialStatus
@@ -92,20 +92,26 @@ class PBT(Algorithm):
             # fully dispatched, awaiting reports for this generation
             return []
         if self._unit is None:  # first generation
-            key = jax.random.key(self.seed)
-            self._unit = np.asarray(self.space.sample_unit(key, self.population))
+            with host_sampling():  # tiny draw: no tunnel round trip
+                key = jax.random.key(self.seed)
+                self._unit = np.asarray(self.space.sample_unit(key, self.population))
             self._spawn_generation(self._unit, None)
             return self._pop_dispatch(n)
-        # close the generation: exploit/explore via the shared kernel
-        key = jax.random.fold_in(jax.random.key(self.seed), 1000 + self.generation)
-        new_unit, src_idx, _ = pbt_exploit_explore(
-            key,
-            jnp.asarray(self._unit),
-            jnp.asarray(self._gen_scores),
-            jnp.asarray(self.space.discrete_mask()),
-            self.config,
-        )
-        self._unit = np.asarray(new_unit)
+        # close the generation: exploit/explore via the shared kernel —
+        # [P]-sized decision math, CPU-pinned for the same reason as
+        # sampling (host_sampling docstring); the FUSED path runs the
+        # same kernel on-device where it composes with the state gather
+        with host_sampling():
+            key = jax.random.fold_in(jax.random.key(self.seed), 1000 + self.generation)
+            new_unit, src_idx, _ = pbt_exploit_explore(
+                key,
+                jnp.asarray(self._unit),
+                jnp.asarray(self._gen_scores),
+                jnp.asarray(self.space.discrete_mask()),
+                self.config,
+            )
+            self._unit = np.asarray(new_unit)
+            src_idx = np.asarray(src_idx)
         self.generation += 1
         if self.finished():
             return []

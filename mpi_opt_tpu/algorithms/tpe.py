@@ -16,7 +16,7 @@ from typing import Sequence
 import jax
 import numpy as np
 
-from mpi_opt_tpu.algorithms.base import Algorithm
+from mpi_opt_tpu.algorithms.base import Algorithm, host_sampling
 from mpi_opt_tpu.ops.tpe import TPEConfig, tpe_suggest
 from mpi_opt_tpu.space import SearchSpace
 from mpi_opt_tpu.trial import TrialResult, TrialStatus
@@ -57,22 +57,26 @@ class TPE(Algorithm):
         take = min(n - len(out), self.max_trials - self._suggested, self.config.n_candidates)
         if take <= 0:
             return out
-        key = jax.random.fold_in(jax.random.key(self.seed), self._suggested)
-        if self._n_obs < self.n_startup:
-            unit = np.asarray(self.space.sample_unit(key, take))
-        else:
-            # round n_suggest up to a power of two so varying batch
-            # remainders hit at most log2(capacity) compiled variants
-            block = 1 << (take - 1).bit_length()
-            sugg, _ = self._suggest_fn(
-                key,
-                self._obs_unit,
-                self._obs_score,
-                self._valid,
-                n_suggest=min(block, self.config.n_candidates),
-                cfg=self.config,
-            )
-            unit = np.asarray(sugg[:take])
+        # CPU-pinned: the acquisition over a 512-row buffer is trivial
+        # compute, and running it tunnel-side costs a round trip per
+        # suggest batch (host_sampling docstring)
+        with host_sampling():
+            key = jax.random.fold_in(jax.random.key(self.seed), self._suggested)
+            if self._n_obs < self.n_startup:
+                unit = np.asarray(self.space.sample_unit(key, take))
+            else:
+                # round n_suggest up to a power of two so varying batch
+                # remainders hit at most log2(capacity) compiled variants
+                block = 1 << (take - 1).bit_length()
+                sugg, _ = self._suggest_fn(
+                    key,
+                    self._obs_unit,
+                    self._obs_score,
+                    self._valid,
+                    n_suggest=min(block, self.config.n_candidates),
+                    cfg=self.config,
+                )
+                unit = np.asarray(sugg[:take])
         for i in range(take):
             t = self._new_trial(unit[i], budget=self.budget)
             t.status = TrialStatus.RUNNING

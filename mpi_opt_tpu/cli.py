@@ -182,17 +182,23 @@ def make_algorithm(args, space):
 def _has_snapshot(directory) -> bool:
     """Does an orbax sweep snapshot already live under ``directory``?
 
-    Orbax lays out one numeric subdirectory per saved step (hyperband
-    nests them under per-bracket dirs), so any digit-named directory in
-    the tree means a previous sweep left state here.
+    Orbax lays out one numeric step directory per save (hyperband nests
+    them under per-bracket dirs), each holding a ``_CHECKPOINT_METADATA``
+    file once the save committed. Requiring BOTH the digit name and the
+    metadata marker keeps unrelated numeric directories sharing the tree
+    (e.g. profiler output ``plugins/profile/2026_07_30/``) from
+    false-positiving a fresh sweep into a hard "pass --resume" error.
     """
     import os
 
     if not directory or not os.path.isdir(directory):
         return False
-    for _root, dirs, _files in os.walk(directory):
-        if any(d.isdigit() for d in dirs):
-            return True
+    for root, dirs, _files in os.walk(directory):
+        for d in dirs:
+            if d.isdigit() and os.path.exists(
+                os.path.join(root, d, "_CHECKPOINT_METADATA")
+            ):
+                return True
     return False
 
 
@@ -222,6 +228,27 @@ def run_fused(args, parser, workload) -> int:
         )
 
     mesh = build_mesh(args)
+    # PBT/TPE keep a standing --population cohort for the whole sweep:
+    # a non-dividing population would replicate on every device (see
+    # parallel.mesh.shard_popstate) and silently run effectively
+    # single-device — fail up front with the fix spelled out. SHA-family
+    # sweeps instead round their shrinking cohorts to the mesh
+    # (round_to), so only their first cohort may warn.
+    if mesh is not None and args.algorithm in ("pbt", "tpe"):
+        n_pop = int(mesh.shape["pop"])
+        # only the population-exceeds-axis case is refused: sharding was
+        # possible and the user plausibly expected it. A population
+        # SMALLER than the axis (debug-sized run on a big mesh) can only
+        # replicate, and gets the runtime warning instead of a hard stop.
+        if args.population % n_pop and args.population > n_pop:
+            lo = (args.population // n_pop) * n_pop
+            parser.error(
+                f"--population {args.population} does not divide the mesh "
+                f"'pop' axis ({n_pop}); the population would be replicated "
+                "on every device instead of sharded. Use --population "
+                f"{lo} or {lo + n_pop}, reshape the mesh with "
+                "--n-pop/--n-data, or pass --no-mesh."
+            )
     # per-chip accounting divides by the devices the sweep ACTUALLY runs
     # on: the mesh's GLOBAL device count when sharded, exactly 1
     # otherwise (local_device_count would overstate the denominator on a

@@ -27,11 +27,29 @@ Design:
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _warn_replicated(n: int, n_pop: int) -> None:
+    """One runtime signal for the replication fallback: silent
+    correctness-preserving replication turns an intended N-device sweep
+    into an effectively single-device one, which a user should learn
+    from a warning, not from a profile."""
+    lo, hi = (n // n_pop) * n_pop, -(-n // n_pop) * n_pop
+    hint = f"e.g. {hi}" if lo == 0 else f"e.g. {lo} or {hi}"
+    warnings.warn(
+        f"population axis of size {n} does not divide the mesh 'pop' axis "
+        f"({n_pop}); the array is replicated on every device instead of "
+        f"sharded — correct, but not member-parallel. Use a population "
+        f"that is a multiple of {n_pop} ({hint}).",
+        RuntimeWarning,
+        stacklevel=3,
+    )
 
 
 def make_mesh(
@@ -75,15 +93,23 @@ def shard_popstate(state: Any, mesh: Mesh) -> Any:
     instead (XLA's device_put rejects uneven shards): correct, just not
     member-parallel — this happens for e.g. an SHA first cohort of 9
     trials on an 8-way mesh, whose later (rounded) rungs shard fully.
+    The fallback WARNS (once per distinct size, via the warnings
+    module's dedup) so it can't silently serialize a sweep.
     """
-    return jax.tree.map(lambda x: place_pop(x, mesh), state)
+    n_pop = mesh.shape["pop"]
+    bad = sorted({l.shape[0] for l in jax.tree.leaves(state) if l.shape[0] % n_pop})
+    for n in bad:
+        _warn_replicated(n, n_pop)
+    return jax.tree.map(lambda x: place_pop(x, mesh, _warn=False), state)
 
 
-def place_pop(x: jax.Array, mesh: Mesh) -> jax.Array:
-    """Place one array's leading axis over ``pop`` (replicates when the
-    axis does not divide — see ``shard_popstate``)."""
+def place_pop(x: jax.Array, mesh: Mesh, _warn: bool = True) -> jax.Array:
+    """Place one array's leading axis over ``pop`` (replicates, with a
+    warning, when the axis does not divide — see ``shard_popstate``)."""
     if x.shape[0] % mesh.shape["pop"] == 0:
         return jax.device_put(x, pop_sharding(mesh))
+    if _warn:
+        _warn_replicated(x.shape[0], mesh.shape["pop"])
     return jax.device_put(x, replicate(mesh))
 
 

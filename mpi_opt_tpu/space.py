@@ -192,12 +192,15 @@ class SearchSpace:
 
     def params_to_unit(self, params: Mapping[str, Any]) -> np.ndarray:
         """Typed-value params dict (one point) -> unit-cube row (host side)."""
+        from mpi_opt_tpu.utils.hostdev import host_ops
+
         row = np.zeros(self.dim, dtype=np.float32)
-        for i, (name, dom) in enumerate(self.domains.items()):
-            v = params[name]
-            if isinstance(dom, Choice):
-                v = dom.value_to_index(v)
-            row[i] = float(np.asarray(dom.to_unit(jnp.asarray(float(v)))))
+        with host_ops():  # scalar ops: never pay an accelerator round trip
+            for i, (name, dom) in enumerate(self.domains.items()):
+                v = params[name]
+                if isinstance(dom, Choice):
+                    v = dom.value_to_index(v)
+                row[i] = float(np.asarray(dom.to_unit(jnp.asarray(float(v)))))
         return row
 
     def sample(self, key: jax.Array, n: int) -> dict[str, jax.Array]:
@@ -207,11 +210,20 @@ class SearchSpace:
     # -- host-side edges --------------------------------------------------
 
     def materialize_row(self, u_row: np.ndarray) -> dict[str, Any]:
-        """One unit-cube row -> a plain-Python hparam dict (host side)."""
+        """One unit-cube row -> a plain-Python hparam dict (host side).
+
+        CPU-pinned: this runs one tiny ``from_unit`` op per dimension
+        per trial — on a tunneled accelerator's default device that is
+        a round trip each, which round 4 measured as ~100 s of a 256-
+        trial driver TPE search (utils.hostdev).
+        """
+        from mpi_opt_tpu.utils.hostdev import host_ops
+
         out = {}
-        for i, (name, dom) in enumerate(self.domains.items()):
-            v = np.asarray(dom.from_unit(jnp.asarray(u_row[i])))
-            out[name] = dom.materialize(v)
+        with host_ops():
+            for i, (name, dom) in enumerate(self.domains.items()):
+                v = np.asarray(dom.from_unit(jnp.asarray(u_row[i])))
+                out[name] = dom.materialize(v)
         return out
 
     def discrete_mask(self) -> np.ndarray:

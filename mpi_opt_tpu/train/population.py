@@ -148,6 +148,14 @@ class PopulationTrainer:
             ),
             self,
         )
+        self.train_segment_masked = functools.partial(
+            jax.jit(
+                type(self)._train_segment_masked,
+                static_argnames=("self", "steps"),
+                donate_argnames=("state",) if donate else (),
+            ),
+            self,
+        )
 
     # -- init -------------------------------------------------------------
 
@@ -236,6 +244,54 @@ class PopulationTrainer:
             member_keys = jax.random.split(k_aug, n)
             st, loss = self._pop_update(st, hp, member_keys, bx, by)
             return (st, k), jnp.mean(loss)
+
+        (state, _), losses = jax.lax.scan(one_step, (state, key), jnp.arange(steps))
+        return state, losses
+
+    def _train_segment_masked(
+        self,
+        state: PopState,
+        hp: OptHParams,
+        train_x: jax.Array,
+        train_y: jax.Array,
+        key: jax.Array,
+        steps: int,
+        rem: jax.Array,  # int32[P]: per-member steps remaining
+    ) -> tuple[PopState, jax.Array]:
+        """``_train_segment`` with per-member step budgets: member m's
+        update applies only while the scan index is < ``rem[m]``, so one
+        program trains a MIXED-budget cohort (an ASHA batch spanning
+        rungs) to each member's own budget. ``steps`` should be
+        ``max(rem)``. Members past their budget still compute a step
+        (SPMD lockstep — there is no early exit inside one program) but
+        the update is discarded, trading those FLOPs for what they buy:
+        ONE launch and ONE score fetch per driver batch instead of one
+        per rung group, which is what the 20-90 ms/RTT tunnel actually
+        charges for (VERDICT r3 item 2). RNG advances in lockstep too,
+        so a member's trajectory depends on its cohort's step schedule —
+        deterministic given the batch plan, not bit-identical to the
+        grouped path.
+        """
+        n = state.step.shape[0]
+        n_data = train_x.shape[0]
+
+        def one_step(carry, t):
+            st, k = carry
+            k, k_batch, k_aug = jax.random.split(k, 3)
+            idx = jax.random.randint(k_batch, (self.batch_size,), 0, n_data)
+            bx = jnp.take(train_x, idx, axis=0)
+            by = jnp.take(train_y, idx, axis=0)
+            bx, by = self._constrain_data(bx, by)
+            member_keys = jax.random.split(k_aug, n)
+            new_st, loss = self._pop_update(st, hp, member_keys, bx, by)
+            active = t < rem  # bool[P]
+
+            def pick(a, b):
+                m = active.reshape((-1,) + (1,) * (a.ndim - 1))
+                return jnp.where(m, a, b)
+
+            st = jax.tree.map(pick, new_st, st)
+            return (st, k), jnp.mean(jnp.where(active, loss, 0.0))
 
         (state, _), losses = jax.lax.scan(one_step, (state, key), jnp.arange(steps))
         return state, losses

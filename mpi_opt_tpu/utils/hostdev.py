@@ -1,0 +1,41 @@
+"""Pinning tiny host-side jax ops to the host CPU backend.
+
+The host search layer (algorithms' sampling, the space's typed-value
+materialization) runs scalar-to-few-KB jax ops between device
+evaluations. On a tunneled accelerator each such op on the DEFAULT
+device pays a full round trip, and that dominates end-to-end walls:
+round 4 measured config-2's driver ASHA spending 56.7 s of a 57.8 s
+search in one-row ``sample_unit`` programs, and config-4's driver TPE
+spending ~100 s in per-dimension ``materialize_row`` ops — against
+1.3 s of actual backend evaluation (probes/probe_driver_asha2.py,
+probe_driver_tpe.py). jax.random is platform-invariant (threefry), so
+CPU-pinning changes no sampled value — only where the op runs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+_CPU = None
+_CHECKED = False
+
+
+def host_ops():
+    """Context manager: run enclosed jax ops on the host CPU device.
+
+    No-op where no CPU backend exists (pure-CPU test processes already
+    default there; exotic platform sets without a cpu backend fall
+    through to the default device).
+    """
+    global _CPU, _CHECKED
+    if not _CHECKED:
+        _CHECKED = True
+        try:
+            _CPU = jax.devices("cpu")[0]
+        except RuntimeError:
+            _CPU = None
+    if _CPU is None:
+        return contextlib.nullcontext()
+    return jax.default_device(_CPU)

@@ -1,0 +1,40 @@
+"""Split the driver-path wall: next_batch vs report_batch vs evaluate.
+Also: is a host CPU jax backend available under the axon plugin?"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+
+import jax
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_tpu")
+
+try:
+    print("cpu devices:", jax.devices("cpu"))
+except Exception as e:
+    print("cpu backend unavailable:", type(e).__name__, e)
+print("default:", jax.devices())
+
+from mpi_opt_tpu.algorithms import get_algorithm
+from mpi_opt_tpu.backends import get_backend
+from mpi_opt_tpu.driver import run_search
+from mpi_opt_tpu.workloads import get_workload
+
+wl = get_workload("fashion_mlp")
+asha = lambda s: get_algorithm("asha")(
+    wl.default_space(), seed=s, max_trials=64, min_budget=10, max_budget=270, eta=3)
+
+be = get_backend("tpu", wl, population=64, seed=0)
+run_search(asha(0), be)
+be.reset()
+
+algo = asha(0)
+t_nb = t_rb = 0.0
+nb0, rb0 = algo.next_batch, algo.report_batch
+def nb(n):
+    global t_nb; t0 = time.perf_counter(); out = nb0(n); t_nb += time.perf_counter() - t0; return out
+def rb(r):
+    global t_rb; t0 = time.perf_counter(); out = rb0(r); t_rb += time.perf_counter() - t0; return out
+algo.next_batch, algo.report_batch = nb, rb
+t0 = time.perf_counter()
+res = run_search(algo, be)
+wall = time.perf_counter() - t0
+be.close()
+print(f"wall {wall:.2f}s  next_batch {t_nb:.2f}s  report_batch {t_rb:.2f}s")

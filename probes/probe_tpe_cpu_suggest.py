@@ -1,0 +1,19 @@
+"""Is tpe_suggest-on-CPU compiling repeatedly / slowly under the axon process?"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+import numpy as np
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_tpu")
+from mpi_opt_tpu.ops.tpe import TPEConfig, tpe_suggest
+
+cfg = TPEConfig()
+fn = jax.jit(tpe_suggest, static_argnames=("n_suggest", "cfg"))
+obs = np.zeros((512, 8), np.float32); sc = np.zeros(512, np.float32); va = np.zeros(512, bool)
+cpu = jax.devices("cpu")[0]
+with jax.default_device(cpu):
+    for i in range(4):
+        t0 = time.perf_counter()
+        k = jax.random.fold_in(jax.random.key(0), i)
+        out, _ = fn(k, obs, sc, va, n_suggest=64, cfg=cfg)
+        np.asarray(out)
+        print(f"call {i}: {time.perf_counter()-t0:.2f}s  device={out.devices()}")

@@ -176,3 +176,26 @@ def test_fused_sha_sharded_rounds_survivors_to_pop_axis(workload):
     )
     assert r["rung_sizes"] == [16, 8]
     assert 0.0 <= r["best_score"] <= 1.0
+
+
+def test_replication_fallback_warns(workload):
+    """A leading axis that doesn't divide the 'pop' axis replicates —
+    correct but effectively single-device, so it must WARN instead of
+    silently serializing the sweep (VERDICT r3 #7)."""
+    import warnings as _w
+
+    import jax.numpy as jnp
+
+    from mpi_opt_tpu.parallel.mesh import place_pop
+
+    mesh = make_mesh(n_pop=8, n_data=1)
+    state = {"w": jnp.zeros((10, 3)), "b": jnp.zeros((10,))}
+    with pytest.warns(RuntimeWarning, match="does not divide the mesh 'pop' axis"):
+        shard_popstate(state, mesh)
+    with pytest.warns(RuntimeWarning, match="multiple of 8"):
+        place_pop(jnp.zeros((9, 2)), mesh)
+    # dividing axes stay silent
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        shard_popstate({"w": jnp.zeros((16, 3))}, mesh)
+        place_pop(jnp.zeros((8, 2)), mesh)

@@ -161,3 +161,46 @@ def test_fused_pbt_rejects_zero_generations():
     wl = get_workload("fashion_mlp", n_train=256, n_val=128)
     with pytest.raises(ValueError, match="generations"):
         fused_pbt(wl, population=4, generations=0, steps_per_gen=5)
+
+
+def test_masked_segment_matches_unmasked_when_uniform(setup):
+    """With every member's rem equal to the segment length, the masked
+    program threads the same RNG and applies every update — bit-identical
+    to train_segment, so the merged driver path costs nothing when the
+    batch isn't actually mixed-budget."""
+    trainer, data = setup
+    st = trainer.init_population(jax.random.key(3), data["train_x"][:2], 4)
+    hp = OptHParams.defaults(4, lr=0.05)
+    a, _ = trainer.train_segment(
+        st, hp, data["train_x"], data["train_y"], jax.random.key(4), 7
+    )
+    b, _ = trainer.train_segment_masked(
+        st, hp, data["train_x"], data["train_y"], jax.random.key(4), 7,
+        jnp.full((4,), 7, jnp.int32),
+    )
+    for xa, xb in zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params)):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+    assert (np.asarray(b.step) == 7).all()
+
+
+def test_masked_segment_freezes_members_at_their_budget(setup):
+    """A mixed-budget batch in one program: member m advances exactly
+    rem[m] steps and is untouched afterwards (the merged ASHA batch's
+    correctness condition — a frozen member's score must be the score AT
+    its budget, not beyond it)."""
+    trainer, data = setup
+    st = trainer.init_population(jax.random.key(5), data["train_x"][:2], 3)
+    hp = OptHParams.defaults(3, lr=0.05)
+    rem = jnp.asarray([0, 2, 6], jnp.int32)
+    out, _ = trainer.train_segment_masked(
+        st, hp, data["train_x"], data["train_y"], jax.random.key(6), 6, rem
+    )
+    assert np.asarray(out.step).tolist() == [0, 2, 6]
+    # member 0 (rem=0) is bit-untouched
+    for xa, xb in zip(jax.tree.leaves(st.params), jax.tree.leaves(out.params)):
+        np.testing.assert_array_equal(np.asarray(xa[0]), np.asarray(xb[0]))
+    # members with rem>0 actually moved
+    k0 = next(l for l in jax.tree.leaves(st.params) if l.ndim >= 3)
+    k1 = next(l for l in jax.tree.leaves(out.params) if l.ndim >= 3)
+    assert not np.allclose(np.asarray(k0[1]), np.asarray(k1[1]))
+    assert not np.allclose(np.asarray(k0[2]), np.asarray(k1[2]))
