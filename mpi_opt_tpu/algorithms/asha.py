@@ -22,7 +22,8 @@ from typing import Sequence
 import jax
 import numpy as np
 
-from mpi_opt_tpu.algorithms.base import Algorithm, host_sampling
+from mpi_opt_tpu.algorithms.base import Algorithm
+from mpi_opt_tpu.utils.hostdev import host_ops
 from mpi_opt_tpu.ops.asha import asha_rungs
 from mpi_opt_tpu.space import SearchSpace
 from mpi_opt_tpu.trial import TrialResult, TrialStatus
@@ -65,10 +66,10 @@ class ASHA(Algorithm):
             t = self.trials[tid]
             t.status = TrialStatus.RUNNING
             out.append(t)
-        # CPU-pinned sampling (host_sampling docstring: one-row samples
+        # CPU-pinned sampling (utils.hostdev: one-row samples
         # on a tunneled default device dominated the whole search wall);
         # also covers BOHB's model-sampling override of _sample_fresh
-        with host_sampling():
+        with host_ops():
             while len(out) < n and self._suggested < self.max_trials:
                 key = jax.random.fold_in(jax.random.key(self.seed), self._suggested)
                 unit = self._sample_fresh(key)

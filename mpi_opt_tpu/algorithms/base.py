@@ -28,16 +28,6 @@ from mpi_opt_tpu.space import SearchSpace
 from mpi_opt_tpu.trial import Trial, TrialResult, TrialStatus
 
 
-def host_sampling():
-    """Context for the algorithms' tiny sampling/decision ops: run them
-    on the host CPU backend instead of paying a tunnel round trip per
-    one-row draw (see utils.hostdev — measured rationale there). Values
-    are bit-identical; only the device changes."""
-    from mpi_opt_tpu.utils.hostdev import host_ops
-
-    return host_ops()
-
-
 def best_finite(items, key):
     """The item with the highest FINITE key, else the first item.
 

@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mpi_opt_tpu.algorithms.base import Algorithm, host_sampling
+from mpi_opt_tpu.algorithms.base import Algorithm
+from mpi_opt_tpu.utils.hostdev import host_ops
 from mpi_opt_tpu.ops.pbt import PBTConfig, pbt_exploit_explore
 from mpi_opt_tpu.space import SearchSpace
 from mpi_opt_tpu.trial import TrialResult, TrialStatus
@@ -92,16 +93,16 @@ class PBT(Algorithm):
             # fully dispatched, awaiting reports for this generation
             return []
         if self._unit is None:  # first generation
-            with host_sampling():  # tiny draw: no tunnel round trip
+            with host_ops():  # tiny draw: no tunnel round trip
                 key = jax.random.key(self.seed)
                 self._unit = np.asarray(self.space.sample_unit(key, self.population))
             self._spawn_generation(self._unit, None)
             return self._pop_dispatch(n)
         # close the generation: exploit/explore via the shared kernel —
         # [P]-sized decision math, CPU-pinned for the same reason as
-        # sampling (host_sampling docstring); the FUSED path runs the
+        # sampling (utils.hostdev rationale); the FUSED path runs the
         # same kernel on-device where it composes with the state gather
-        with host_sampling():
+        with host_ops():
             key = jax.random.fold_in(jax.random.key(self.seed), 1000 + self.generation)
             new_unit, src_idx, _ = pbt_exploit_explore(
                 key,

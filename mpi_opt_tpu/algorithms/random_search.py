@@ -7,7 +7,8 @@ from typing import Sequence
 import jax
 import numpy as np
 
-from mpi_opt_tpu.algorithms.base import Algorithm, host_sampling
+from mpi_opt_tpu.algorithms.base import Algorithm
+from mpi_opt_tpu.utils.hostdev import host_ops
 from mpi_opt_tpu.space import SearchSpace
 from mpi_opt_tpu.trial import TrialResult, TrialStatus
 
@@ -28,7 +29,7 @@ class RandomSearch(Algorithm):
         take = min(n - len(out), self.max_trials - self._suggested)
         if take <= 0:
             return out
-        with host_sampling():  # tiny draw: never pay a tunnel round trip
+        with host_ops():  # tiny draw: never pay a tunnel round trip
             key = jax.random.fold_in(jax.random.key(self.seed), self._suggested)
             unit = np.asarray(self.space.sample_unit(key, take))
         for i in range(take):

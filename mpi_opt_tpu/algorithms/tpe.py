@@ -16,7 +16,8 @@ from typing import Sequence
 import jax
 import numpy as np
 
-from mpi_opt_tpu.algorithms.base import Algorithm, host_sampling
+from mpi_opt_tpu.algorithms.base import Algorithm
+from mpi_opt_tpu.utils.hostdev import host_ops
 from mpi_opt_tpu.ops.tpe import TPEConfig, tpe_suggest
 from mpi_opt_tpu.space import SearchSpace
 from mpi_opt_tpu.trial import TrialResult, TrialStatus
@@ -59,8 +60,8 @@ class TPE(Algorithm):
             return out
         # CPU-pinned: the acquisition over a 512-row buffer is trivial
         # compute, and running it tunnel-side costs a round trip per
-        # suggest batch (host_sampling docstring)
-        with host_sampling():
+        # suggest batch (utils.hostdev rationale)
+        with host_ops():
             key = jax.random.fold_in(jax.random.key(self.seed), self._suggested)
             if self._n_obs < self.n_startup:
                 unit = np.asarray(self.space.sample_unit(key, take))

@@ -265,7 +265,12 @@ class TPUPopulationBackend(Backend):
         )
         self._pool = self._place_pool(_scatter(self._pool, sub, jnp.asarray(out_slots)))
 
-        scores = np.asarray(scores)
+        # fetch_global: on a process-spanning mesh (config-5 multi-host)
+        # eval_population's output is not fully addressable and a plain
+        # np.asarray raises
+        from mpi_opt_tpu.parallel.mesh import fetch_global
+
+        scores = fetch_global(scores)
         wall = time.perf_counter() - t0
         out: dict[int, TrialResult] = {}
         for i, (t, _, _, _, _) in enumerate(entries):

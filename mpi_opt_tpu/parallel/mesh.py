@@ -137,6 +137,28 @@ def constrain_pop(tree: Any, mesh: Optional[Mesh]) -> Any:
     )
 
 
+def fetch_global(x) -> np.ndarray:
+    """Host copy of a possibly multi-process-sharded array.
+
+    ``np.asarray`` works only on fully-addressable arrays, so it breaks
+    the moment a fused sweep's mesh spans OS processes (config 5's
+    v4-32 topology is multi-HOST: every process runs the same host
+    ledger code and needs the same global values). Three cases:
+    single-process arrays fetch directly; a fully-replicated
+    multi-process output is read from any local shard; an
+    actually-sharded one is assembled with ``process_allgather`` (a
+    collective — every process must reach this call, which holds
+    because SPMD processes execute identical host code).
+    """
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        if x.sharding.is_fully_replicated:
+            return np.asarray(x.addressable_shards[0].data)
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
 def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

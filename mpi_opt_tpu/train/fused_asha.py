@@ -96,7 +96,7 @@ def fused_sha(
     snapshot — produces the IDENTICAL result of an uninterrupted run.
     A config-mismatched checkpoint raises ValueError.
     """
-    from mpi_opt_tpu.parallel.mesh import place_pop, shard_popstate
+    from mpi_opt_tpu.parallel.mesh import fetch_global, place_pop, shard_popstate
 
     trainer, space, train_x, train_y, val_x, val_y = workload_arrays(
         workload, member_chunk, mesh
@@ -189,7 +189,7 @@ def fused_sha(
                 state, hp, train_x, train_y, k_seg, budget - prev_budget
             )
             scores = trainer.eval_population(state, val_x, val_y)
-            np_scores = np.asarray(scores)
+            np_scores = fetch_global(scores)
             stop_rung[alive] = r
             last_score[alive] = np_scores
             rung_history.append(
@@ -207,9 +207,13 @@ def fused_sha(
                     # re-place: the gather may leave survivors unsharded/skewed
                     state = shard_popstate(state, mesh)
                     unit = place_pop(unit, mesh)
-                alive = alive[np.asarray(keep)]
-                # post-cut survivors' scores, for a resume-at-complete result
-                np_scores = np.asarray(scores)[np.asarray(keep)]
+                np_keep = fetch_global(keep)
+                alive = alive[np_keep]
+                # post-cut survivors' scores, for a resume-at-complete
+                # result (np_scores already holds this rung's fetch —
+                # re-fetching would pay an extra cross-process allgather
+                # per rung under multi-host)
+                np_scores = np_scores[np_keep]
             if snap is not None:
                 # scores saved = the CURRENT cohort rows (post-cut when cut)
                 snap.save_population_sweep(
@@ -226,8 +230,8 @@ def fused_sha(
         if snap is not None:
             snap.close()
 
-    np_unit = np.asarray(unit)
-    final_scores = np.asarray(scores)
+    np_unit = fetch_global(unit)
+    final_scores = fetch_global(scores)
     # one diverged survivor (NaN, or +/-inf from an exploded loss) must
     # not hijack the bracket's best — argmax would return the NaN/+inf
     # row. Same isfinite rule as the host path's best_finite; the

@@ -235,7 +235,7 @@ def fused_pbt(
     """
     import numpy as np
 
-    from mpi_opt_tpu.parallel.mesh import shard_popstate
+    from mpi_opt_tpu.parallel.mesh import fetch_global, shard_popstate
     from mpi_opt_tpu.train.common import workload_arrays
 
     if generations < 1:  # before any data/device work
@@ -376,10 +376,11 @@ def fused_pbt(
                     cfg=cfg,
                 )
             # curves to host eagerly: they are tiny, and a later crash
-            # must not lose completed launches' history
-            best_parts.append(np.asarray(best))
-            mean_parts.append(np.asarray(mean))
-            scores = np.asarray(final_scores)
+            # must not lose completed launches' history (fetch_global:
+            # under multi-process SPMD these are global arrays)
+            best_parts.append(fetch_global(best))
+            mean_parts.append(fetch_global(mean))
+            scores = fetch_global(final_scores)
             # the fetches above are the launch's completion barrier
             # (block_until_ready is unreliable under the axon plugin —
             # PERF_NOTES.md), so the duration is measured AFTER them and
@@ -409,13 +410,14 @@ def fused_pbt(
     best = np.concatenate(best_parts)
     mean = np.concatenate(mean_parts)
     best_i = int(scores.argmax())
+    np_unit = fetch_global(unit)
     return {
         "best_score": float(scores[best_i]),
-        "best_params": space.materialize_row(np.asarray(unit)[best_i]),
+        "best_params": space.materialize_row(np_unit[best_i]),
         "best_curve": np.asarray(best),
         "mean_curve": np.asarray(mean),
         "state": state,
-        "unit": np.asarray(unit),
+        "unit": np_unit,
         # measured per-launch durations + generation split, for
         # launch-granular wall-to-target (utils.metrics); on a resumed
         # sweep, pre-crash launches' durations come from the snapshot.

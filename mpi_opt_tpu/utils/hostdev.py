@@ -33,7 +33,13 @@ def host_ops():
     if not _CHECKED:
         _CHECKED = True
         try:
-            _CPU = jax.devices("cpu")[0]
+            # local_devices, not devices: in a multi-process world
+            # jax.devices() spans every process, and devices("cpu")[0]
+            # is PROCESS 0's device — pinning another process's host
+            # ops to it commits tiny arrays to a remote device and
+            # kills that process (found by the 2-process fused-SHA
+            # test: rank 1 died exactly there)
+            _CPU = jax.local_devices(backend="cpu")[0]
         except RuntimeError:
             _CPU = None
     if _CPU is None:
