@@ -62,8 +62,12 @@ DATASETS = {
         n_train, n_val, 32, 32, 3, 10, seed=seed,
         **{"delta": 0.1, "noise": 2.0, "protos": 16, "coarse": 8, **kw}
     ),
+    # label_noise=0.35: irreducible-error ceiling 1 - p + p/K = 0.6535,
+    # so config-5's val-acc curve plateaus ~0.65 instead of memorizing
+    # to 0.999 (round-3 verdict weak #3) and a 0.5 target sits mid-curve
     "cifar100": lambda seed=0, n_train=16384, n_val=2048, **kw: make_image_classification(
-        n_train, n_val, 32, 32, 3, 100, seed=seed, **{"coarse": 6, "noise": 1.2, "delta": 0.3, **kw}
+        n_train, n_val, 32, 32, 3, 100, seed=seed,
+        **{"coarse": 6, "noise": 1.2, "delta": 0.3, "label_noise": 0.35, **kw}
     ),
 }
 

@@ -170,6 +170,8 @@ def fused_tpe(
             done = sum(sizes[:start_gen])
             best_curve = [float(v) for v in meta["best_curve"]]
 
+    from mpi_opt_tpu.parallel.mesh import fetch_global
+
     try:
         for g in range(start_gen, len(sizes)):
             obs_unit, obs_scores, valid, key, scores, _ = tpe_generation(
@@ -189,15 +191,20 @@ def fused_tpe(
                 cfg=cfg,
             )
             done += sizes[g]
-            running = float(jnp.max(jnp.where(valid, obs_scores, -jnp.inf)))
+            # fetch_global: under multi-process SPMD the buffer is a
+            # process-spanning (replicated) global array
+            running = float(fetch_global(jnp.max(jnp.where(valid, obs_scores, -jnp.inf))))
             best_curve.append(running)
             if snap is not None:
+                # fetch_global for the payload too — np.asarray on the
+                # process-spanning buffers raises, killing the sweep at
+                # its first snapshot exactly where the mesh needs it
                 snap.save(
                     g + 1,
                     sweep={
-                        "obs_unit": np.asarray(obs_unit),
-                        "obs_scores": np.asarray(obs_scores),
-                        "valid": np.asarray(valid),
+                        "obs_unit": fetch_global(obs_unit),
+                        "obs_scores": fetch_global(obs_scores),
+                        "valid": fetch_global(valid),
                         "key_data": np.asarray(jax.random.key_data(key)),
                     },
                     meta_extra={"gens_done": g + 1, "best_curve": best_curve},
@@ -206,15 +213,17 @@ def fused_tpe(
         if snap is not None:
             snap.close()
 
-    np_scores = np.array(obs_scores)  # copy: np.asarray of a jax.Array is read-only
-    np_valid = np.asarray(valid)
+    np_unit = fetch_global(obs_unit)
+    raw_scores = fetch_global(obs_scores)
+    np_scores = np.array(raw_scores)  # copy: masked in place below
+    np_valid = fetch_global(valid)
     np_scores[~np_valid] = -np.inf
     best_i = int(np_scores.argmax())
     return {
         "best_score": float(np_scores[best_i]),
-        "best_params": space.materialize_row(np.asarray(obs_unit)[best_i]),
+        "best_params": space.materialize_row(np_unit[best_i]),
         "best_curve": np.asarray(best_curve, dtype=np.float32),
-        "obs_unit": np.asarray(obs_unit),
-        "obs_scores": np.asarray(obs_scores),
+        "obs_unit": np_unit,
+        "obs_scores": raw_scores,
         "n_trials": n_trials,
     }

@@ -54,3 +54,23 @@ def test_difficulty_kwargs_passthrough():
     import numpy as np
 
     assert not np.allclose(easy["train_x"], hard["train_x"])
+
+
+def test_label_noise_ceiling():
+    """cifar100 carries a 0.35 label-noise fraction: an oracle that
+    always predicts the TRUE class scores ~ 1 - p + p/K on the noisy
+    labels — the irreducible ceiling that stops config-5's curve from
+    memorizing to ~1.0 (round-3 verdict weak #3)."""
+    import numpy as np
+
+    from mpi_opt_tpu.data.synthetic import make_image_classification
+
+    clean = make_image_classification(2048, 2048, 8, 8, 1, 100, seed=7)
+    noisy = make_image_classification(2048, 2048, 8, 8, 1, 100, seed=7, label_noise=0.35)
+    # identical images, labels re-drawn for ~p*(1-1/K) of samples
+    np.testing.assert_array_equal(clean["train_x"], noisy["train_x"])
+    frac = float((clean["val_y"] != noisy["val_y"]).mean())
+    assert 0.30 < frac < 0.40, frac  # p*(1-1/K) = 0.3465
+    # oracle accuracy on noisy labels = agreement with the true labels
+    oracle = float((noisy["val_y"] == clean["val_y"]).mean())
+    assert abs(oracle - 0.6535) < 0.03, oracle
