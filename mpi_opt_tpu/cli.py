@@ -127,7 +127,62 @@ def build_parser() -> argparse.ArgumentParser:
         help="disable the automatic ('pop','data') mesh on multi-device "
         "hosts (run single-device)",
     )
+    # failure recovery (SURVEY.md §5): accelerator runtimes demonstrably
+    # die mid-sweep (this container's tunneled TPU worker crashes and
+    # restarts); fused sweeps are crash-recoverable via --checkpoint-dir,
+    # and --retries closes the loop by resuming automatically
+    p.add_argument(
+        "--retries",
+        type=int,
+        default=0,
+        help="fused: auto-retry the sweep this many times on a TRANSIENT "
+        "runtime failure (worker crash/restart, unavailable, deadline). "
+        "With --checkpoint-dir each retry resumes at the last snapshot; "
+        "without, it restarts the (deterministic) sweep from scratch",
+    )
     return p
+
+
+_TRANSIENT_MARKERS = (
+    "crashed",
+    "restarted",
+    "unavailable",
+    "deadline",
+    "socket closed",
+    "connection reset",
+    # NOT "cancelled": when an async op fails, the runtime reports its
+    # dependents as CANCELLED — retrying one of those secondary errors
+    # would re-run a genuine program bug N times
+)
+
+
+def _is_transient(e: BaseException) -> bool:
+    """Platform-failure heuristic: retry-worthy errors name the runtime
+    dying, not the program being wrong (a shape error or OOM retried N
+    times is N identical failures)."""
+    return any(m in str(e).lower() for m in _TRANSIENT_MARKERS)
+
+
+def _run_with_retries(launch, retries: int, metrics):
+    """Run ``launch()``; on a transient runtime failure, retry up to
+    ``retries`` times. Callers pass a closure over a fused sweep whose
+    checkpoint machinery (if enabled) turns each retry into a resume —
+    the automatic form of the kill-and-rerun recovery the snapshot
+    tests prove by hand."""
+    attempt = 0
+    while True:
+        try:
+            return launch()
+        except Exception as e:
+            if attempt >= retries or not _is_transient(e):
+                raise
+            attempt += 1
+            metrics.log(
+                "retry",
+                attempt=attempt,
+                of=retries,
+                error=f"{type(e).__name__}: {e}"[:300],
+            )
 
 
 def build_mesh(args):
@@ -218,6 +273,20 @@ def run_fused(args, parser, workload) -> int:
 
     if not isinstance(workload, PopulationWorkload):
         parser.error(f"--fused requires a population workload, not {args.workload!r}")
+    if args.retries:
+        import jax
+
+        if jax.process_count() > 1:
+            # a per-process retry under multi-process SPMD is unsound:
+            # one process restoring a snapshot while its peers sit in a
+            # collective issues mismatched programs and hangs the job.
+            # Recovery there is job-level: rerun (snapshots resume it).
+            parser.error(
+                "--retries requires a single-process run; under "
+                "multi-process SPMD recovery is a coordinated job "
+                "restart (re-launch with the same --checkpoint-dir to "
+                "resume)"
+            )
     # resuming is explicit opt-in, matching the driver path: a stale
     # checkpoint dir must not silently replay an old sweep (ADVICE r2)
     if args.checkpoint_dir and not args.resume and _has_snapshot(args.checkpoint_dir):
@@ -263,7 +332,7 @@ def run_fused(args, parser, workload) -> int:
         if args.algorithm == "pbt":
             from mpi_opt_tpu.train.fused_pbt import fused_pbt
 
-            res = fused_pbt(
+            res = _run_with_retries(lambda: fused_pbt(
                 workload,
                 population=args.population,
                 generations=args.generations,
@@ -276,7 +345,7 @@ def run_fused(args, parser, workload) -> int:
                 step_chunk=args.step_chunk,
                 checkpoint_dir=args.checkpoint_dir,
                 snapshot_every=args.checkpoint_every,
-            )
+            ), args.retries, metrics)
             n_trials = args.population * args.generations
             extra = {"best_curve": [round(float(v), 4) for v in res["best_curve"]]}
         elif args.algorithm in ("asha", "random"):
@@ -289,7 +358,7 @@ def run_fused(args, parser, workload) -> int:
                 lo = hi = args.budget
             else:
                 lo, hi = args.min_budget, args.max_budget
-            res = fused_sha(
+            res = _run_with_retries(lambda: fused_sha(
                 workload,
                 n_trials=args.trials,
                 min_budget=lo,
@@ -299,13 +368,13 @@ def run_fused(args, parser, workload) -> int:
                 member_chunk=args.member_chunk,
                 mesh=mesh,
                 checkpoint_dir=args.checkpoint_dir,
-            )
+            ), args.retries, metrics)
             n_trials = res["n_trials"]
             extra = {"rung_sizes": res["rung_sizes"], "rung_budgets": res["rung_budgets"]}
         elif args.algorithm == "tpe":
             from mpi_opt_tpu.train.fused_tpe import fused_tpe
 
-            res = fused_tpe(
+            res = _run_with_retries(lambda: fused_tpe(
                 workload,
                 n_trials=args.trials,
                 batch=args.population,
@@ -314,13 +383,13 @@ def run_fused(args, parser, workload) -> int:
                 member_chunk=args.member_chunk,
                 mesh=mesh,
                 checkpoint_dir=args.checkpoint_dir,
-            )
+            ), args.retries, metrics)
             n_trials = res["n_trials"]
             extra = {"best_curve": [round(float(v), 4) for v in res["best_curve"]]}
         elif args.algorithm == "hyperband":
             from mpi_opt_tpu.train.fused_asha import fused_hyperband
 
-            res = fused_hyperband(
+            res = _run_with_retries(lambda: fused_hyperband(
                 workload,
                 max_budget=args.max_budget,
                 eta=args.eta,
@@ -328,13 +397,13 @@ def run_fused(args, parser, workload) -> int:
                 member_chunk=args.member_chunk,
                 mesh=mesh,
                 checkpoint_dir=args.checkpoint_dir,
-            )
+            ), args.retries, metrics)
             n_trials = res["n_trials"]
             extra = {"brackets": res["brackets"]}
         elif args.algorithm == "bohb":
             from mpi_opt_tpu.train.fused_bohb import fused_bohb
 
-            res = fused_bohb(
+            res = _run_with_retries(lambda: fused_bohb(
                 workload,
                 max_budget=args.max_budget,
                 eta=args.eta,
@@ -342,7 +411,7 @@ def run_fused(args, parser, workload) -> int:
                 member_chunk=args.member_chunk,
                 mesh=mesh,
                 checkpoint_dir=args.checkpoint_dir,
-            )
+            ), args.retries, metrics)
             n_trials = res["n_trials"]
             extra = {"brackets": res["brackets"]}
         else:

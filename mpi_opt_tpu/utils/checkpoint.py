@@ -183,18 +183,32 @@ class SweepCheckpointer:
     def save_population_sweep(self, step, state, unit, key, scores, meta_extra):
         """Snapshot the standard fused-sweep payload. Host-fetches the
         population state BEFORE the async save (the caller's next launch
-        donates those device buffers)."""
+        donates those device buffers). Fetches via ``fetch_global`` so a
+        sweep sharded over a process-spanning mesh can snapshot: every
+        process fetches the same global value (a collective for sharded
+        leaves) and orbax's own multihost coordination handles the write.
+        """
         import jax
         import numpy as np
 
-        host = jax.device_get(
-            {"params": state.params, "momentum": state.momentum, "step": state.step}
-        )
+        from mpi_opt_tpu.parallel.mesh import fetch_global
+
+        tree = {"params": state.params, "momentum": state.momentum, "step": state.step}
+        if all(
+            not isinstance(l, jax.Array) or l.is_fully_addressable
+            for l in jax.tree.leaves(tree)
+        ):
+            # single-process: one batched fetch (a ResNet pool is dozens
+            # of leaves; per-leaf synchronous fetches would lengthen the
+            # pause before the async save)
+            host = jax.device_get(tree)
+        else:
+            host = jax.tree.map(fetch_global, tree)
         self.save(
             step,
             sweep={
                 "state": host,
-                "unit": np.asarray(unit),
+                "unit": fetch_global(unit),
                 "key_data": np.asarray(jax.random.key_data(key)),
                 "scores": np.asarray(scores),
             },

@@ -213,8 +213,7 @@ def test_fused_tpe_cli(capsys):
 
 
 def _summary(capsys):
-    lines = [l for l in capsys.readouterr().out.strip().splitlines() if l.startswith("{")]
-    return json.loads(lines[-1])
+    return _summary_from(capsys.readouterr().out)
 
 
 def test_fused_cli_auto_mesh(capsys):
@@ -350,3 +349,73 @@ def test_fused_population_must_divide_mesh(capsys):
     err = capsys.readouterr().err
     assert "does not divide the mesh 'pop' axis" in err
     assert "--population 96 or 104" in err
+
+
+def test_fused_retries_transient_failure(capsys, monkeypatch):
+    """--retries N: a transient runtime death (worker crash/restart)
+    mid-sweep is retried — with --checkpoint-dir that retry is a resume,
+    the automatic form of the kill-and-rerun recovery the snapshot tests
+    prove by hand (SURVEY.md §5 failure recovery)."""
+    import mpi_opt_tpu.train.fused_pbt as fpbt
+
+    real = fpbt.fused_pbt
+    calls = {"n": 0}
+
+    def flaky(workload, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("TPU worker process crashed or restarted")
+        return real(workload, **kw)
+
+    monkeypatch.setattr(fpbt, "fused_pbt", flaky)
+    argv = [
+        "--workload", "fashion_mlp",
+        "--algorithm", "pbt",
+        "--fused",
+        "--population", "8",
+        "--generations", "2",
+        "--steps-per-generation", "4",
+        "--no-mesh",
+    ]
+    # without --retries the failure propagates
+    with pytest.raises(RuntimeError, match="crashed"):
+        main(argv)
+    capsys.readouterr()
+    calls["n"] = 0
+    assert main(argv + ["--retries", "1"]) == 0
+    assert calls["n"] == 2
+    out = capsys.readouterr().out
+    assert '"event": "retry"' in out  # the retry is visible in metrics
+    summary = _summary_from(out)
+    assert 0.0 <= summary["best_score"] <= 1.0
+
+
+def test_fused_retries_never_mask_program_errors(monkeypatch, capsys):
+    """A non-transient error (the program being wrong) is NEVER retried:
+    N retries of a shape error are N identical failures."""
+    import mpi_opt_tpu.train.fused_pbt as fpbt
+
+    calls = {"n": 0}
+
+    def broken(workload, **kw):
+        calls["n"] += 1
+        raise ValueError("bad shapes")
+
+    monkeypatch.setattr(fpbt, "fused_pbt", broken)
+    with pytest.raises(ValueError, match="bad shapes"):
+        main([
+            "--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+            "--population", "4", "--generations", "1", "--no-mesh",
+            "--retries", "3",
+        ])
+    assert calls["n"] == 1
+    capsys.readouterr()
+
+
+def _summary_from(out):
+    lines = [l for l in out.strip().splitlines() if l.startswith("{")]
+    for l in reversed(lines):
+        d = json.loads(l)
+        if "best_score" in d:
+            return d
+    raise AssertionError(out)

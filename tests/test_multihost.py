@@ -143,3 +143,75 @@ def test_two_process_fused_sweeps_agree():
     # identical best score, curve, winner, and rung plan in BOTH processes
     assert pbt[0].split(" ", 2)[2] == pbt[1].split(" ", 2)[2], pbt
     assert sha[0].split(" ", 2)[2] == sha[1].split(" ", 2)[2], sha
+
+
+# -- checkpoint/resume across the process boundary -----------------------
+#
+# The failure-recovery story must survive multi-host too: a sweep
+# sharded over a process-spanning mesh snapshots via fetch_global'd
+# host copies + orbax's own multihost coordination, and a re-run with
+# the same arguments replays from the final snapshot bit-identically in
+# EVERY process.
+
+_CKPT_WORKER = r"""
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cpu")
+
+from mpi_opt_tpu.parallel.mesh import make_mesh, initialize_multihost
+
+pid, port, ck = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+initialize_multihost(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+)
+
+from mpi_opt_tpu.train.fused_pbt import fused_pbt
+from mpi_opt_tpu.workloads import get_workload
+
+mesh = make_mesh(n_pop=2, n_data=2)
+wl = get_workload("fashion_mlp", n_train=256, n_val=128)
+wl.batch_size = 32
+
+kw = dict(population=4, generations=2, steps_per_gen=2, seed=0, mesh=mesh,
+          gen_chunk=1, checkpoint_dir=ck)
+res = fused_pbt(wl, **kw)
+curve = ",".join(f"{v:.6f}" for v in res["best_curve"])
+print(f"RUN1 {pid} {res['best_score']:.6f} [{curve}]", flush=True)
+res2 = fused_pbt(wl, **kw)  # resumes from the final snapshot: pure replay
+curve2 = ",".join(f"{v:.6f}" for v in res2["best_curve"])
+print(f"RUN2 {pid} {res2['best_score']:.6f} [{curve2}]", flush=True)
+"""
+
+
+def test_two_process_checkpointed_sweep_replays(tmp_path):
+    ck = str(tmp_path / "ck")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CKPT_WORKER, str(pid), str(port), ck],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd="/root/repo",
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=420)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        outs.append(out)
+    lines = {}
+    for out in outs:
+        for l in out.splitlines():
+            if l.startswith("RUN"):
+                tag, pid, rest = l.split(" ", 2)
+                lines[(tag, pid)] = rest
+    # the checkpointed sweep and its replay agree, in BOTH processes
+    assert lines[("RUN1", "0")] == lines[("RUN1", "1")], lines
+    assert lines[("RUN2", "0")] == lines[("RUN2", "1")], lines
+    assert lines[("RUN1", "0")] == lines[("RUN2", "0")], lines
