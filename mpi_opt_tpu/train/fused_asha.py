@@ -179,6 +179,31 @@ def fused_sha(
         state = shard_popstate(state, mesh)
         unit = place_pop(unit, mesh)
 
+    def record_rung(r: int, np_scores_r) -> None:
+        """Ledger update for one rung's PRE-cut cohort — the single
+        source for both the eager (checkpointed) and deferred-replay
+        paths, which must produce identical result ledgers."""
+        stop_rung[alive] = r
+        last_score[alive] = np_scores_r
+        rung_history.append(
+            {
+                "budget": int(rungs[r]),
+                "trials": [int(i) for i in alive],
+                "scores": [float(v) for v in np_scores_r],
+            }
+        )
+
+    # Uncheckpointed sweeps DEFER every host fetch to one barrier after
+    # the last rung: the per-rung score/keep values feed only the host
+    # ledger (consumed after the sweep), so the rung programs can
+    # dispatch back-to-back — the wall becomes device time instead of
+    # launch + round-trip per rung (the tunnel charges 20-90 ms per
+    # blocking fetch; a 4-rung config-2 sweep paid ~7 of them).
+    # Checkpointed sweeps keep the per-rung fetch: each snapshot needs
+    # host copies of the ledger at that rung.
+    defer = snap is None
+    rung_scores_dev: list = []  # device scores per rung (pre-cut rows)
+    rung_keep_dev: list = []  # device survivor indices per cut
     try:
         for r in range(start_rung, len(rungs)):
             budget = rungs[r]
@@ -189,16 +214,11 @@ def fused_sha(
                 state, hp, train_x, train_y, k_seg, budget - prev_budget
             )
             scores = trainer.eval_population(state, val_x, val_y)
-            np_scores = fetch_global(scores)
-            stop_rung[alive] = r
-            last_score[alive] = np_scores
-            rung_history.append(
-                {
-                    "budget": int(budget),
-                    "trials": [int(i) for i in alive],
-                    "scores": [float(v) for v in np_scores],
-                }
-            )
+            if defer:
+                rung_scores_dev.append(scores)
+            else:
+                np_scores = fetch_global(scores)
+                record_rung(r, np_scores)
             if r < len(rungs) - 1:
                 state, unit, keep, _ = _cut_and_gather(
                     trainer, state, unit, scores, eta, sizes[r + 1]
@@ -207,13 +227,16 @@ def fused_sha(
                     # re-place: the gather may leave survivors unsharded/skewed
                     state = shard_popstate(state, mesh)
                     unit = place_pop(unit, mesh)
-                np_keep = fetch_global(keep)
-                alive = alive[np_keep]
-                # post-cut survivors' scores, for a resume-at-complete
-                # result (np_scores already holds this rung's fetch —
-                # re-fetching would pay an extra cross-process allgather
-                # per rung under multi-host)
-                np_scores = np_scores[np_keep]
+                if defer:
+                    rung_keep_dev.append(keep)
+                else:
+                    np_keep = fetch_global(keep)
+                    alive = alive[np_keep]
+                    # post-cut survivors' scores, for a resume-at-complete
+                    # result (np_scores already holds this rung's fetch —
+                    # re-fetching would pay an extra cross-process allgather
+                    # per rung under multi-host)
+                    np_scores = np_scores[np_keep]
             if snap is not None:
                 # scores saved = the CURRENT cohort rows (post-cut when cut)
                 snap.save_population_sweep(
@@ -230,8 +253,28 @@ def fused_sha(
         if snap is not None:
             snap.close()
 
+    final_np_scores = None
+    if defer and rung_scores_dev:
+        # the single host barrier: fetch every rung's scores/cuts and
+        # replay the ledger updates the eager path did per rung. One
+        # BATCHED device_get when fully addressable — per-array fetches
+        # are sequential round trips, which is the cost being deferred
+        all_dev = rung_scores_dev + rung_keep_dev
+        if all(not isinstance(x, jax.Array) or x.is_fully_addressable for x in all_dev):
+            fetched = jax.device_get(all_dev)
+        else:
+            fetched = [fetch_global(x) for x in all_dev]
+        np_rung_scores = fetched[: len(rung_scores_dev)]
+        np_keeps = fetched[len(rung_scores_dev):]
+        final_np_scores = np_rung_scores[-1]  # last rung has no cut
+        for r_off, np_scores in enumerate(np_rung_scores):
+            r = start_rung + r_off
+            record_rung(r, np_scores)
+            if r < len(rungs) - 1:
+                alive = alive[np_keeps[r_off]]
+
     np_unit = fetch_global(unit)
-    final_scores = fetch_global(scores)
+    final_scores = fetch_global(scores) if final_np_scores is None else final_np_scores
     # one diverged survivor (NaN, or +/-inf from an exploded loss) must
     # not hijack the bracket's best — argmax would return the NaN/+inf
     # row. Same isfinite rule as the host path's best_finite; the

@@ -98,3 +98,17 @@ def test_fused_sha_one_nan_does_not_hijack(monkeypatch):
     assert r["diverged"] is False
     assert r["best_trial"] == 2
     assert r["best_score"] == pytest.approx(0.9)
+
+
+def test_deferred_fetch_matches_checkpointed_ledger(tmp_path, workload):
+    """Uncheckpointed sweeps defer all host fetches to one end-of-sweep
+    barrier; the replayed ledger must be IDENTICAL to the eager
+    (checkpointed) path's — same rung history, stop rungs, and best."""
+    kw = dict(n_trials=9, min_budget=2, max_budget=8, eta=2, seed=3)
+    deferred = fused_sha(workload, **kw)
+    eager = fused_sha(workload, checkpoint_dir=str(tmp_path / "ck"), **kw)
+    assert deferred["best_score"] == eager["best_score"]
+    assert deferred["best_trial"] == eager["best_trial"]
+    assert deferred["rung_history"] == eager["rung_history"]
+    np.testing.assert_array_equal(deferred["stop_rung"], eager["stop_rung"])
+    np.testing.assert_array_equal(deferred["last_score"], eager["last_score"])
