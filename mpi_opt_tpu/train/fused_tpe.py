@@ -172,6 +172,15 @@ def fused_tpe(
 
     from mpi_opt_tpu.parallel.mesh import fetch_global
 
+    # uncheckpointed sweeps defer the per-generation running-best fetch
+    # (one tunnel round trip each) to a single batched barrier at the
+    # end — the same deferral fused_sha applies to its rung ledger;
+    # checkpointed sweeps keep it eager (each snapshot records the
+    # curve so far). fused_pbt deliberately does NOT defer: its
+    # per-launch fetch doubles as the launch-duration barrier that
+    # launch-granular wall-to-target accounting needs.
+    defer = snap is None
+    curve_dev: list = []
     try:
         for g in range(start_gen, len(sizes)):
             obs_unit, obs_scores, valid, key, scores, _ = tpe_generation(
@@ -191,10 +200,13 @@ def fused_tpe(
                 cfg=cfg,
             )
             done += sizes[g]
-            # fetch_global: under multi-process SPMD the buffer is a
-            # process-spanning (replicated) global array
-            running = float(fetch_global(jnp.max(jnp.where(valid, obs_scores, -jnp.inf))))
-            best_curve.append(running)
+            running_dev = jnp.max(jnp.where(valid, obs_scores, -jnp.inf))
+            if defer:
+                curve_dev.append(running_dev)
+            else:
+                # fetch_global: under multi-process SPMD the buffer is a
+                # process-spanning (replicated) global array
+                best_curve.append(float(fetch_global(running_dev)))
             if snap is not None:
                 # fetch_global for the payload too — np.asarray on the
                 # process-spanning buffers raises, killing the sweep at
@@ -213,6 +225,11 @@ def fused_tpe(
         if snap is not None:
             snap.close()
 
+    if curve_dev:
+        if all(not isinstance(x, jax.Array) or x.is_fully_addressable for x in curve_dev):
+            best_curve.extend(float(v) for v in jax.device_get(curve_dev))
+        else:
+            best_curve.extend(float(fetch_global(v)) for v in curve_dev)
     np_unit = fetch_global(obs_unit)
     raw_scores = fetch_global(obs_scores)
     np_scores = np.array(raw_scores)  # copy: masked in place below
