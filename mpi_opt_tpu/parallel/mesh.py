@@ -159,6 +159,23 @@ def fetch_global(x) -> np.ndarray:
     return np.asarray(x)
 
 
+def fetch_global_batched(arrays) -> list:
+    """Host copies of many arrays with ONE transfer when possible.
+
+    The deferred-barrier pattern (fused SHA's rung ledger, fused TPE's
+    curve) accumulates device values and flushes once — but flushing
+    with per-array fetches still pays one round trip each, which
+    measured no better than not deferring at all. Fully-addressable
+    sets batch through a single ``jax.device_get``; process-spanning
+    sets fall back to per-array ``fetch_global`` (collective order must
+    stay identical across processes).
+    """
+    arrays = list(arrays)
+    if all(not isinstance(x, jax.Array) or x.is_fully_addressable for x in arrays):
+        return list(jax.device_get(arrays))
+    return [fetch_global(x) for x in arrays]
+
+
 def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

@@ -255,15 +255,12 @@ def fused_sha(
 
     final_np_scores = None
     if defer and rung_scores_dev:
-        # the single host barrier: fetch every rung's scores/cuts and
-        # replay the ledger updates the eager path did per rung. One
-        # BATCHED device_get when fully addressable — per-array fetches
-        # are sequential round trips, which is the cost being deferred
-        all_dev = rung_scores_dev + rung_keep_dev
-        if all(not isinstance(x, jax.Array) or x.is_fully_addressable for x in all_dev):
-            fetched = jax.device_get(all_dev)
-        else:
-            fetched = [fetch_global(x) for x in all_dev]
+        # the single host barrier: fetch every rung's scores/cuts in one
+        # batched transfer and replay the ledger updates the eager path
+        # did per rung
+        from mpi_opt_tpu.parallel.mesh import fetch_global_batched
+
+        fetched = fetch_global_batched(rung_scores_dev + rung_keep_dev)
         np_rung_scores = fetched[: len(rung_scores_dev)]
         np_keeps = fetched[len(rung_scores_dev):]
         final_np_scores = np_rung_scores[-1]  # last rung has no cut

@@ -174,11 +174,11 @@ def fused_tpe(
 
     # uncheckpointed sweeps defer the per-generation running-best fetch
     # (one tunnel round trip each) to a single batched barrier at the
-    # end — the same deferral fused_sha applies to its rung ledger;
-    # checkpointed sweeps keep it eager (each snapshot records the
-    # curve so far). fused_pbt deliberately does NOT defer: its
-    # per-launch fetch doubles as the launch-duration barrier that
-    # launch-granular wall-to-target accounting needs.
+    # end — the same deferral train/fused_asha.py's fused_sha applies
+    # to its rung ledger; checkpointed sweeps keep it eager (each
+    # snapshot records the curve so far). fused_pbt deliberately does
+    # NOT defer: its per-launch fetch doubles as the launch-duration
+    # barrier that launch-granular wall-to-target accounting needs.
     defer = snap is None
     curve_dev: list = []
     try:
@@ -226,10 +226,9 @@ def fused_tpe(
             snap.close()
 
     if curve_dev:
-        if all(not isinstance(x, jax.Array) or x.is_fully_addressable for x in curve_dev):
-            best_curve.extend(float(v) for v in jax.device_get(curve_dev))
-        else:
-            best_curve.extend(float(fetch_global(v)) for v in curve_dev)
+        from mpi_opt_tpu.parallel.mesh import fetch_global_batched
+
+        best_curve.extend(float(v) for v in fetch_global_batched(curve_dev))
     np_unit = fetch_global(obs_unit)
     raw_scores = fetch_global(obs_scores)
     np_scores = np.array(raw_scores)  # copy: masked in place below
