@@ -11,14 +11,26 @@ start via sitecustomize, so platform selection must go through
 whole suite on the real TPU chip).
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", False)
-# Persistent compilation cache: the suite is compile-bound. Platform-
-# specific dir — mixing artifacts compiled elsewhere (axon remote
-# compile) triggers machine-feature mismatch warnings/SIGILL risk.
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cpu")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+# Persistent compilation cache: OFF by default since round 4. The
+# shared cache dir accumulated XLA:CPU AOT entries carrying another
+# machine's CPU features (this image runs a remote compile service —
+# PALLAS_AXON_REMOTE_COMPILE), and loading/serializing big entries
+# late in a full-suite process produced machine-feature-mismatch ERROR
+# logs escalating to SIGABRT/SIGSEGV inside
+# jax/_src/compilation_cache.py (PERF_NOTES.md round 4; reproduced on
+# both the read and write paths, never in isolated runs). A fully
+# recompiled suite costs ~2x wall but finishes deterministically.
+# Opt back in for local iteration with MPI_OPT_TPU_TEST_CACHE=1; if a
+# crash whose traceback touches compilation_cache appears, purge
+# /tmp/jax_cache_cpu and unset the flag.
+if os.environ.get("MPI_OPT_TPU_TEST_CACHE") == "1":
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cpu")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
