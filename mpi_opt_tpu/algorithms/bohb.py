@@ -196,6 +196,7 @@ class BOHB(Hyperband):
         d["bohb"] = {
             "samples": self._samples,
             "buffer_size": self.buffer_size,
+            "n_min": self.n_min,
             "obs": self.obs.to_jsonable(),
         }
         return d
@@ -216,6 +217,16 @@ class BOHB(Hyperband):
             raise ValueError(
                 f"checkpoint is for bohb(buffer_size={saved}), "
                 f"not buffer_size={self.buffer_size}"
+            )
+        # n_min is the model-qualification threshold: resuming under a
+        # different value silently changes WHEN the model engages.
+        # setdefault (like momentum_dtype) keeps pre-upgrade checkpoints
+        # loadable under the instance's current value
+        saved_n_min = int(b.get("n_min", self.n_min))
+        if saved_n_min != self.n_min:
+            raise ValueError(
+                f"checkpoint is for bohb(n_min={saved_n_min}), "
+                f"not n_min={self.n_min}"
             )
         super().load_state_dict(state)
         self._samples = int(b["samples"])

@@ -159,7 +159,19 @@ _TRANSIENT_MARKERS = (
 def _is_transient(e: BaseException) -> bool:
     """Platform-failure heuristic: retry-worthy errors name the runtime
     dying, not the program being wrong (a shape error or OOM retried N
-    times is N identical failures)."""
+    times is N identical failures).
+
+    Two gates, both required: the exception TYPE must be one the
+    accelerator runtime actually raises (JaxRuntimeError — the class the
+    tunneled worker's crash/unavailable/deadline errors arrive as — or a
+    transport-layer OSError), and its message must name the runtime
+    dying. Type-first keeps a program error that merely QUOTES a marker
+    (a dataset path containing 'unavailable', a user exception citing a
+    'deadline') from being retried N times (ADVICE r4)."""
+    import jax.errors
+
+    if not isinstance(e, (jax.errors.JaxRuntimeError, OSError)):
+        return False
     return any(m in str(e).lower() for m in _TRANSIENT_MARKERS)
 
 
@@ -175,6 +187,12 @@ def _run_with_retries(launch, retries: int, metrics):
             return launch()
         except Exception as e:
             if attempt >= retries or not _is_transient(e):
+                if attempt:  # the retries were burned: record what won
+                    metrics.log(
+                        "retry_exhausted",
+                        attempts=attempt,
+                        error=f"{type(e).__name__}: {e}"[:1000],
+                    )
                 raise
             attempt += 1
             metrics.log(

@@ -54,6 +54,22 @@ def workload_arrays(workload, member_chunk: int = 0, mesh=None):
     return workload._fused_cache[1:]
 
 
+def finite_winner(scores, ok=None):
+    """(best_i, diverged) for a host score vector: the argmax over
+    finite (and ``ok``-masked) entries, with argmax's first-NaN behavior
+    gated out — the numpy-level twin of ``algorithms.base.best_finite``,
+    shared by the fused SHA/PBT/TPE winner picks so the divergence rule
+    lives in ONE place. An all-diverged set returns (0, True): callers
+    report best_params=None and a non-finite best_score."""
+    import numpy as np
+
+    scores = np.asarray(scores)
+    mask = np.isfinite(scores) if ok is None else (np.asarray(ok) & np.isfinite(scores))
+    diverged = not bool(mask.any())
+    best_i = 0 if diverged else int(np.where(mask, scores, -np.inf).argmax())
+    return best_i, diverged
+
+
 def momentum_dtype_str() -> str:
     """Checkpoint-config form of the momentum storage dtype ('float32'
     default). Part of every fused sweep's config-mismatch check: the

@@ -33,7 +33,7 @@ import jax
 import numpy as np
 
 from mpi_opt_tpu.ops.asha import asha_cut, asha_rungs
-from mpi_opt_tpu.train.common import momentum_dtype_str, workload_arrays
+from mpi_opt_tpu.train.common import finite_winner, momentum_dtype_str, workload_arrays
 
 
 @functools.partial(jax.jit, static_argnames=("trainer", "eta", "k"))
@@ -274,14 +274,14 @@ def fused_sha(
     final_scores = fetch_global(scores) if final_np_scores is None else final_np_scores
     # one diverged survivor (NaN, or +/-inf from an exploded loss) must
     # not hijack the bracket's best — argmax would return the NaN/+inf
-    # row. Same isfinite rule as the host path's best_finite; the
-    # all-diverged cohort reports non-finite/None with diverged=True,
-    # so no arbitrary row masquerades as a meaningful winner
-    finite = np.isfinite(final_scores)
-    diverged = not bool(finite.any())
-    best_row = 0 if diverged else int(np.where(finite, final_scores, -np.inf).argmax())
+    # row. Shared rule: train.common.finite_winner; the all-diverged
+    # cohort reports non-finite/None with diverged=True, so no
+    # arbitrary row masquerades as a meaningful winner
+    best_row, diverged = finite_winner(final_scores)
     return {
-        "best_score": float(final_scores[best_row]),
+        # diverged normalizes to NaN (not a raw +/-inf row) so library
+        # callers can detect it uniformly across fused SHA/PBT/TPE
+        "best_score": float("nan") if diverged else float(final_scores[best_row]),
         "best_params": None if diverged else space.materialize_row(np_unit[best_row]),
         "best_trial": None if diverged else int(alive[best_row]),
         "diverged": diverged,

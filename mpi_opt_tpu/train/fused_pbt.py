@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from mpi_opt_tpu.ops.pbt import PBTConfig, pbt_exploit_explore
-from mpi_opt_tpu.train.common import momentum_dtype_str
+from mpi_opt_tpu.train.common import finite_winner, momentum_dtype_str
 from mpi_opt_tpu.train.population import OptHParams, PopState, PopulationTrainer
 
 
@@ -409,11 +409,18 @@ def fused_pbt(
             snap.close()
     best = np.concatenate(best_parts)
     mean = np.concatenate(mean_parts)
-    best_i = int(scores.argmax())
+    # a diverged member (NaN, or +/-inf from an exploded loss) must not
+    # hijack the winner via argmax's first-NaN behavior — shared rule:
+    # train.common.finite_winner; an all-diverged population reports
+    # best_params=None with diverged=True
+    best_i, diverged = finite_winner(scores)
     np_unit = fetch_global(unit)
     return {
-        "best_score": float(scores[best_i]),
-        "best_params": space.materialize_row(np_unit[best_i]),
+        # diverged normalizes to NaN (not a raw +/-inf row) so library
+        # callers can detect it uniformly across fused SHA/PBT/TPE
+        "best_score": float("nan") if diverged else float(scores[best_i]),
+        "best_params": None if diverged else space.materialize_row(np_unit[best_i]),
+        "diverged": diverged,
         "best_curve": np.asarray(best),
         "mean_curve": np.asarray(mean),
         "state": state,

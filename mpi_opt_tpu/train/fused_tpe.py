@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpi_opt_tpu.ops.tpe import TPEConfig, tpe_suggest
-from mpi_opt_tpu.train.common import momentum_dtype_str, workload_arrays
+from mpi_opt_tpu.train.common import finite_winner, momentum_dtype_str, workload_arrays
 
 
 @functools.partial(
@@ -200,7 +200,12 @@ def fused_tpe(
                 cfg=cfg,
             )
             done += sizes[g]
-            running_dev = jnp.max(jnp.where(valid, obs_scores, -jnp.inf))
+            # valid alone is not enough: one valid-but-NaN observation
+            # would propagate through jnp.max into every later curve
+            # point — gate on finiteness too (same rule as best_i below)
+            running_dev = jnp.max(
+                jnp.where(valid & jnp.isfinite(obs_scores), obs_scores, -jnp.inf)
+            )
             if defer:
                 curve_dev.append(running_dev)
             else:
@@ -231,13 +236,18 @@ def fused_tpe(
         best_curve.extend(float(v) for v in fetch_global_batched(curve_dev))
     np_unit = fetch_global(obs_unit)
     raw_scores = fetch_global(obs_scores)
-    np_scores = np.array(raw_scores)  # copy: masked in place below
+    np_scores = np.asarray(raw_scores)
     np_valid = fetch_global(valid)
-    np_scores[~np_valid] = -np.inf
-    best_i = int(np_scores.argmax())
+    # invalid rows AND non-finite scores are barred from the winner
+    # pick: a valid-but-NaN observation must not win argmax (NaN sorts
+    # first). Shared rule: train.common.finite_winner; an all-diverged
+    # sweep reports best_params=None / best_score NaN with
+    # diverged=True, matching fused SHA/PBT
+    best_i, diverged = finite_winner(np_scores, ok=np_valid)
     return {
-        "best_score": float(np_scores[best_i]),
-        "best_params": space.materialize_row(np_unit[best_i]),
+        "best_score": float("nan") if diverged else float(np_scores[best_i]),
+        "best_params": None if diverged else space.materialize_row(np_unit[best_i]),
+        "diverged": diverged,
         "best_curve": np.asarray(best_curve, dtype=np.float32),
         "obs_unit": np_unit,
         "obs_scores": raw_scores,

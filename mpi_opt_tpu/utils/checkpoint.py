@@ -210,7 +210,11 @@ class SweepCheckpointer:
                 "state": host,
                 "unit": fetch_global(unit),
                 "key_data": np.asarray(jax.random.key_data(key)),
-                "scores": np.asarray(scores),
+                # fetch_global, not np.asarray: both current callers pass
+                # host arrays (no-op), but the docstring invites device
+                # arrays and a process-spanning scores shard would crash
+                # at its first snapshot otherwise
+                "scores": fetch_global(scores),
             },
             meta_extra=meta_extra,
         )

@@ -194,6 +194,23 @@ def test_bohb_checkpoint_roundtrip():
     assert algo.finished() and resumed.finished()
 
 
+def test_bohb_checkpoint_validates_n_min():
+    """n_min is the model-qualification threshold: a checkpoint written
+    under a different value must be refused (silently resuming under a
+    changed threshold changes WHEN the model engages) — while a
+    pre-upgrade checkpoint with no recorded n_min stays loadable
+    (ADVICE r4)."""
+    space = _space()
+    st = BOHB(space, seed=0, max_budget=9, eta=3, n_min=5).state_dict()
+    algo = BOHB(space, seed=0, max_budget=9, eta=3, n_min=7)
+    with pytest.raises(ValueError, match=r"n_min=5.*not n_min=7"):
+        algo.load_state_dict(st)
+    # pre-upgrade checkpoints carry no n_min: setdefault to the
+    # instance's value, matching the momentum_dtype pattern
+    del st["bohb"]["n_min"]
+    BOHB(space, seed=0, max_budget=9, eta=3, n_min=7).load_state_dict(st)
+
+
 def test_obsstore_drops_inf_scores():
     """+/-inf scores (exploded losses) are as model-poisoning as NaN:
     they'd blow up the KDE moments/bandwidths. Same isfinite gate, same

@@ -2,6 +2,8 @@
 
 import json
 
+import jax.errors
+
 import pytest
 
 from mpi_opt_tpu.cli import build_parser, main
@@ -364,7 +366,11 @@ def test_fused_retries_transient_failure(capsys, monkeypatch):
     def flaky(workload, **kw):
         calls["n"] += 1
         if calls["n"] == 1:
-            raise RuntimeError("TPU worker process crashed or restarted")
+            # the class the tunneled runtime's crash errors arrive as —
+            # _is_transient type-gates on it before the marker scan
+            raise jax.errors.JaxRuntimeError(
+                "TPU worker process crashed or restarted"
+            )
         return real(workload, **kw)
 
     monkeypatch.setattr(fpbt, "fused_pbt", flaky)
@@ -403,6 +409,30 @@ def test_fused_retries_never_mask_program_errors(monkeypatch, capsys):
 
     monkeypatch.setattr(fpbt, "fused_pbt", broken)
     with pytest.raises(ValueError, match="bad shapes"):
+        main([
+            "--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+            "--population", "4", "--generations", "1", "--no-mesh",
+            "--retries", "3",
+        ])
+    assert calls["n"] == 1
+    capsys.readouterr()
+
+
+def test_fused_retries_type_gate_beats_marker_text(monkeypatch, capsys):
+    """A program error whose MESSAGE happens to quote a transient marker
+    (a dataset path containing 'unavailable') must not be retried: the
+    type gate runs before the substring scan (ADVICE r4 / VERDICT r4
+    weak #4)."""
+    import mpi_opt_tpu.train.fused_pbt as fpbt
+
+    calls = {"n": 0}
+
+    def broken(workload, **kw):
+        calls["n"] += 1
+        raise ValueError("dataset file '/data/unavailable/train.npz' deadline")
+
+    monkeypatch.setattr(fpbt, "fused_pbt", broken)
+    with pytest.raises(ValueError, match="unavailable"):
         main([
             "--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
             "--population", "4", "--generations", "1", "--no-mesh",
