@@ -53,13 +53,16 @@ print(f"RESULT {pid} {val}", flush=True)
 """
 
 
-def _run_two_procs(worker_src: str, timeout: int = 420) -> list[str]:
+def _run_two_procs(worker_src: str, extra_args=(), timeout: int = 420) -> list[str]:
+    """Spawn 2 SPMD worker ranks (argv: pid, coordinator port, *extra)
+    and return their stdouts; kills stragglers on any failure so a hung
+    rank can't outlive the test. Shared with test_multihost_families."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", worker_src, str(pid), str(port)],
+            [sys.executable, "-c", worker_src, str(pid), str(port), *extra_args],
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
@@ -68,10 +71,16 @@ def _run_two_procs(worker_src: str, timeout: int = 420) -> list[str]:
         for pid in (0, 1)
     ]
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=timeout)
-        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
-        outs.append(out)
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     return outs
 
 
@@ -189,22 +198,7 @@ print(f"RUN2 {pid} {res2['best_score']:.6f} [{curve2}]", flush=True)
 
 def test_two_process_checkpointed_sweep_replays(tmp_path):
     ck = str(tmp_path / "ck")
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", _CKPT_WORKER, str(pid), str(port), ck],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            cwd="/root/repo",
-        )
-        for pid in (0, 1)
-    ]
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=420)
-        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
-        outs.append(out)
+    outs = _run_two_procs(_CKPT_WORKER, extra_args=(ck,))
     lines = {}
     for out in outs:
         for l in out.splitlines():

@@ -1,0 +1,125 @@
+"""Cross-process execution for the sweep families with per-rank host
+state (VERDICT r4 missing #1).
+
+test_multihost.py proves bring-up + fused PBT/SHA + checkpoint replay
+across 2 OS processes. The components that had NEVER crossed a process
+boundary are exactly the ones whose host-side state could silently
+diverge between SPMD ranks:
+
+- fused TPE: its host loop issues ``fetch_global`` collectives whose
+  ORDER must match in every rank (deferred end-of-sweep curve barrier);
+- fused BOHB: per-bracket orbax checkpoints + persisted model-sampled
+  cohorts on a SHARED directory under multihost coordination;
+- the driver slot-pool backend: a host-side LRU ledger
+  (``backends/tpu.py``) that must make identical slot decisions in
+  every rank or the gather/scatter programs diverge.
+
+Each worker runs the real component on a global ('pop','data') mesh
+spanning 2 processes x 2 CPU devices and prints its result; the test
+asserts the output is IDENTICAL in both ranks (the SPMD contract).
+"""
+
+from test_multihost import _run_two_procs
+
+_PRELUDE = r"""
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cpu")
+
+from mpi_opt_tpu.parallel.mesh import make_mesh, initialize_multihost
+
+pid, port = int(sys.argv[1]), sys.argv[2]
+initialize_multihost(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+)
+mesh = make_mesh(n_pop=2, n_data=2)
+assert len(set(d.process_index for d in mesh.devices.flat)) == 2
+
+from mpi_opt_tpu.workloads import get_workload
+
+wl = get_workload("fashion_mlp", n_train=256, n_val=128)
+wl.batch_size = 32
+"""
+
+_TPE_WORKER = _PRELUDE + r"""
+from mpi_opt_tpu.train.fused_tpe import fused_tpe
+
+# no checkpoint_dir -> the DEFERRED curve path: every generation's
+# running-best stays on device and the end-of-sweep flush issues one
+# fetch_global per point — a fixed collective sequence both ranks must
+# execute identically
+res = fused_tpe(wl, n_trials=8, batch=4, budget=2, seed=0, mesh=mesh)
+curve = ",".join(f"{v:.6f}" for v in res["best_curve"])
+obs = ",".join(f"{v:.6f}" for v in res["obs_scores"])
+print(f"TPE {pid} {res['best_score']:.6f} [{curve}] [{obs}]", flush=True)
+"""
+
+_BOHB_WORKER = _PRELUDE + r"""
+from mpi_opt_tpu.train.fused_bohb import fused_bohb
+
+ck = sys.argv[3]
+kw = dict(max_budget=4, eta=2, seed=0, mesh=mesh, n_min=2,
+          checkpoint_dir=ck)
+res = fused_bohb(wl, **kw)
+model = [b.get("n_model_sampled") for b in res["brackets"]]
+print(f"BOHB1 {pid} {res['best_score']:.6f} {model} "
+      f"{[b['rung_sizes'] for b in res['brackets']]}", flush=True)
+# second run on the SAME shared directory: every bracket replays from
+# its final snapshot and the persisted cohorts short-circuit the model
+# resample — both ranks must replay to the identical result
+res2 = fused_bohb(wl, **kw)
+model2 = [b.get("n_model_sampled") for b in res2["brackets"]]
+print(f"BOHB2 {pid} {res2['best_score']:.6f} {model2}", flush=True)
+assert res2["best_score"] == res["best_score"], (res2, res)
+"""
+
+_DRIVER_WORKER = _PRELUDE + r"""
+from mpi_opt_tpu.algorithms import ASHA
+from mpi_opt_tpu.backends import get_backend
+from mpi_opt_tpu.driver import run_search
+
+algo = ASHA(wl.default_space(), seed=10, max_trials=8, min_budget=2,
+            max_budget=4, eta=2)
+be = get_backend("tpu", wl, population=4, seed=10, mesh=mesh)
+res = run_search(algo, be)
+# the LRU ledger's final state is the transcript of every slot decision
+# this rank made — byte-identical ledgers mean the ranks issued the
+# same gather/scatter programs all sweep long
+ledger = sorted(be._slot_of.items())
+trained = sorted(be._trained.items())
+print(f"DRIVER {pid} {res.best.score:.6f} {res.n_trials} "
+      f"{ledger} {trained}", flush=True)
+"""
+
+
+def _tagged(outs, tag):
+    """The payload (everything after 'TAG pid ') of each rank's line."""
+    return [
+        next(l for l in out.splitlines() if l.startswith(tag)).split(" ", 2)[2]
+        for out in outs
+    ]
+
+
+def test_two_process_fused_tpe_agrees():
+    outs = _run_two_procs(_TPE_WORKER)
+    a, b = _tagged(outs, "TPE")
+    assert a == b, outs
+
+
+def test_two_process_fused_bohb_checkpointed_agrees(tmp_path):
+    ck = str(tmp_path / "bohb_ck")
+    outs = _run_two_procs(_BOHB_WORKER, extra_args=(ck,), timeout=600)
+    r1a, r1b = _tagged(outs, "BOHB1")
+    r2a, r2b = _tagged(outs, "BOHB2")
+    assert r1a == r1b, outs
+    assert r2a == r2b, outs
+
+
+def test_two_process_driver_slot_pool_agrees():
+    outs = _run_two_procs(_DRIVER_WORKER)
+    a, b = _tagged(outs, "DRIVER")
+    assert a == b, outs
