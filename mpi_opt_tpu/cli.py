@@ -104,6 +104,39 @@ def build_parser() -> argparse.ArgumentParser:
         "exceeds the platform's execution window; deterministic, "
         "checkpoint-guarded, not bit-identical to unchunked)",
     )
+    # multi-host bring-up (SURVEY.md §2 row 1 + §5): the reference's
+    # ``mpirun`` launch WAS its user surface; the CLI owns SPMD bring-up
+    # the same way — one OS process per host, each invoking this CLI
+    # with its rank, called BEFORE any backend/mesh construction
+    # (jax.distributed must initialize before the XLA backend exists)
+    p.add_argument(
+        "--coordinator",
+        default=None,
+        metavar="HOST:PORT",
+        help="multi-process SPMD: the rank-0 coordinator address. Give "
+        "together with --num-processes/--process-id on every rank "
+        "(the mpirun-equivalent launch); on TPU pods --multihost alone "
+        "auto-detects all three from pod metadata",
+    )
+    p.add_argument(
+        "--num-processes",
+        type=int,
+        default=None,
+        help="multi-process SPMD: total process count (with --coordinator)",
+    )
+    p.add_argument(
+        "--process-id",
+        type=int,
+        default=None,
+        help="multi-process SPMD: this process's rank (with --coordinator)",
+    )
+    p.add_argument(
+        "--multihost",
+        action="store_true",
+        help="bring up jax.distributed via cluster auto-detection (TPU "
+        "pod metadata); fails rather than silently running "
+        "single-process. Implied by --coordinator",
+    )
     # mesh / multi-chip (SURVEY.md §2 row 9: the communication layer,
     # reachable from the user surface)
     p.add_argument(
@@ -475,6 +508,38 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.resume and not args.checkpoint_dir:
         parser.error("--resume requires --checkpoint-dir")
+    # multi-host bring-up FIRST: jax.distributed must initialize before
+    # anything touches the XLA backend (build_mesh, workload data,
+    # backend construction all do)
+    explicit = (args.coordinator, args.num_processes, args.process_id)
+    if any(v is not None for v in explicit) and not all(
+        v is not None for v in explicit
+    ):
+        parser.error(
+            "--coordinator, --num-processes and --process-id must be "
+            "given together (or use --multihost alone for TPU-pod "
+            "auto-detection)"
+        )
+    if args.multihost or args.coordinator is not None:
+        from mpi_opt_tpu.parallel.mesh import initialize_multihost
+
+        try:
+            initialize_multihost(
+                coordinator_address=args.coordinator,
+                num_processes=args.num_processes,
+                process_id=args.process_id,
+                require=True,
+            )
+        except (ValueError, RuntimeError) as e:
+            # loud but actionable, matching every other user-input
+            # failure's parser.error surface — not a raw jax traceback
+            parser.error(
+                f"multi-host bring-up failed: {e}\n(--multihost needs "
+                "TPU-pod metadata; off-pod, pass --coordinator "
+                "HOST:PORT --num-processes N --process-id RANK on every "
+                "rank, and note bring-up must happen before any other "
+                "JAX use in the process)"
+            )
     workload = get_workload(args.workload)
     if args.fused:
         return run_fused(args, parser, workload)

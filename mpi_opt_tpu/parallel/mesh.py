@@ -180,6 +180,7 @@ def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    require: bool = False,
 ) -> int:
     """Bring up the multi-host runtime (config 5: v4-32-scale sweeps).
 
@@ -205,7 +206,10 @@ def initialize_multihost(
     try:
         jax.distributed.initialize(**kwargs)
     except (ValueError, RuntimeError):
-        # an explicit multi-host request must not silently shrink
-        if coordinator_address is not None or num_processes not in (None, 1):
+        # an explicit multi-host request must not silently shrink.
+        # ``require`` covers the auto-detect form (CLI --multihost on a
+        # box with no pod metadata): the user asked for a multi-process
+        # world, so a failed bring-up is an error, not a fallback.
+        if require or coordinator_address is not None or num_processes not in (None, 1):
             raise
     return jax.process_index()

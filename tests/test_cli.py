@@ -418,6 +418,19 @@ def test_fused_retries_never_mask_program_errors(monkeypatch, capsys):
     capsys.readouterr()
 
 
+def test_multihost_flags_must_be_complete(capsys):
+    """Partial bring-up flags are a launch-script bug: refuse with the
+    full recipe rather than auto-detecting half a cluster."""
+    with pytest.raises(SystemExit):
+        main([
+            "--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+            "--population", "4", "--generations", "1", "--no-mesh",
+            "--coordinator", "127.0.0.1:1234",
+        ])
+    err = capsys.readouterr().err
+    assert "--coordinator, --num-processes and --process-id" in err
+
+
 def test_fused_retries_type_gate_beats_marker_text(monkeypatch, capsys):
     """A program error whose MESSAGE happens to quote a transient marker
     (a dataset path containing 'unavailable') must not be retried: the
