@@ -137,6 +137,20 @@ def build_parser() -> argparse.ArgumentParser:
         "pod metadata); fails rather than silently running "
         "single-process. Implied by --coordinator",
     )
+    p.add_argument(
+        "--platform",
+        default=None,
+        choices=["cpu", "tpu"],
+        help="force the jax platform at config level (env vars are "
+        "unreliable under site plugins); cpu + --local-devices N gives "
+        "an N-device virtual host for debugging SPMD launches off-pod",
+    )
+    p.add_argument(
+        "--local-devices",
+        type=int,
+        default=None,
+        help="with --platform cpu: virtual device count for this process",
+    )
     # mesh / multi-chip (SURVEY.md §2 row 9: the communication layer,
     # reachable from the user surface)
     p.add_argument(
@@ -508,9 +522,28 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.resume and not args.checkpoint_dir:
         parser.error("--resume requires --checkpoint-dir")
-    # multi-host bring-up FIRST: jax.distributed must initialize before
-    # anything touches the XLA backend (build_mesh, workload data,
-    # backend construction all do)
+    # platform pinning, then multi-host bring-up, BEFORE anything
+    # touches the XLA backend (build_mesh, workload data, backend
+    # construction all do) — both are only possible pre-initialization
+    if args.platform is not None or args.local_devices is not None:
+        if args.local_devices is not None:
+            if args.platform != "cpu":
+                parser.error("--local-devices requires --platform cpu")
+            if args.local_devices < 1:
+                parser.error(
+                    f"--local-devices must be >= 1, got {args.local_devices}"
+                )
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", args.platform)
+            if args.local_devices is not None:
+                jax.config.update("jax_num_cpu_devices", args.local_devices)
+        except RuntimeError as e:
+            parser.error(
+                f"--platform/--local-devices must be set before any JAX "
+                f"use in this process: {e}"
+            )
     explicit = (args.coordinator, args.num_processes, args.process_id)
     if any(v is not None for v in explicit) and not all(
         v is not None for v in explicit

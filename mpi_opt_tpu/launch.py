@@ -1,0 +1,223 @@
+"""Coordinated multi-process launch + recovery supervisor.
+
+SURVEY.md §5 (failure detection / elastic recovery) for the one
+topology where per-process ``--retries`` is unsound: multi-process
+SPMD. One rank restoring a snapshot while its peers sit in a collective
+issues mismatched programs and hangs the job — so recovery there must
+be a COORDINATED job restart. This module is that coordination, and the
+``mpirun``-equivalent front door (the reference's launcher role):
+
+    python -m mpi_opt_tpu.launch --n-proc 4 --retries 2 -- \
+        --workload cifar100_resnet18 --algorithm pbt --fused \
+        --checkpoint-dir /ckpt/sweep --population 1024 ...
+
+It spawns ``--n-proc`` ranks of ``python -m mpi_opt_tpu`` (appending
+``--coordinator/--num-processes/--process-id`` for each), watches them,
+and on ANY rank death kills the survivors and relaunches ALL ranks —
+with ``--resume`` appended when the job has a ``--checkpoint-dir``, so
+the restarted job continues from the last shared snapshot and (because
+fused-sweep resume is bit-identical, tested) finishes with the result
+the unkilled job would have produced. Without a checkpoint dir a
+restart re-runs the (deterministic) sweep from scratch.
+
+Transient-vs-program classification is deliberately NOT attempted here:
+a supervisor sees exit codes, not exception types. A program bug burns
+its retries quickly (each relaunch fails in seconds at the same point)
+and surfaces the rank's stderr; a platform death resumes and completes.
+
+Per-rank stdout/stderr go to ``--log-dir`` (default: a temp dir,
+printed) as ``rank{i}.out``/``rank{i}.err``, truncated per attempt;
+rank 0's final summary line is re-printed on the supervisor's stdout so
+scripted callers keep the single-JSON-line contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_ranks(n: int, rest: list[str], log_dir: str):
+    """One attempt's rank processes; a fresh coordinator port each time
+    (the previous attempt's port may linger in TIME_WAIT)."""
+    port = _free_port()
+    procs = []
+    for i in range(n):
+        argv = [
+            sys.executable,
+            "-m",
+            "mpi_opt_tpu",
+            *rest,
+            "--coordinator",
+            f"127.0.0.1:{port}",
+            "--num-processes",
+            str(n),
+            "--process-id",
+            str(i),
+        ]
+        out = open(os.path.join(log_dir, f"rank{i}.out"), "w")
+        err = open(os.path.join(log_dir, f"rank{i}.err"), "w")
+        procs.append(
+            (subprocess.Popen(argv, stdout=out, stderr=err, text=True), out, err)
+        )
+    return procs
+
+
+def _kill_all(procs) -> None:
+    for p, out, err in procs:
+        if p.poll() is None:
+            p.kill()
+    for p, out, err in procs:
+        p.wait()
+        out.close()
+        err.close()
+
+
+def _watch(procs, poll_s: float):
+    """Block until every rank exits 0 (returns None) or any rank fails
+    (returns its index; survivors are killed — they are mid-collective
+    with a dead peer and will never finish on their own)."""
+    try:
+        while True:
+            running = False
+            for i, (p, _, _) in enumerate(procs):
+                rc = p.poll()
+                if rc is None:
+                    running = True
+                elif rc != 0:
+                    return i
+            if not running:
+                return None
+            time.sleep(poll_s)
+    finally:
+        _kill_all(procs)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi_opt_tpu.launch",
+        description="spawn + supervise an N-process SPMD job with "
+        "coordinated restart-on-failure recovery",
+    )
+    parser.add_argument("--n-proc", type=int, required=True)
+    parser.add_argument(
+        "--retries",
+        type=int,
+        default=0,
+        help="coordinated full-job restarts after any rank death "
+        "(resumes from the last snapshot when the job checkpoints)",
+    )
+    parser.add_argument("--log-dir", default=None, help="per-rank stdout/stderr")
+    parser.add_argument(
+        "--poll-interval", type=float, default=0.2, help="rank liveness poll (s)"
+    )
+    parser.add_argument(
+        "rest",
+        nargs=argparse.REMAINDER,
+        help="-- followed by the mpi_opt_tpu CLI arguments for every rank",
+    )
+    args = parser.parse_args(argv)
+    rest = args.rest
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    if not rest:
+        parser.error("pass the per-rank CLI arguments after '--'")
+    if args.n_proc < 1:
+        parser.error(f"--n-proc must be >= 1, got {args.n_proc}")
+    # argparse accepts both '--flag value' and '--flag=value'; match
+    # flags by token prefix so the '=' spelling can't slip through the
+    # ownership guard (or, below, defeat the --resume recovery append)
+    def _has_flag(tokens, flag):
+        return any(t == flag or t.startswith(flag + "=") for t in tokens)
+
+    for banned in ("--coordinator", "--num-processes", "--process-id", "--retries"):
+        if _has_flag(rest, banned):
+            parser.error(
+                f"{banned} is owned by the supervisor; don't pass it in "
+                "the per-rank arguments"
+            )
+    log_dir = args.log_dir or tempfile.mkdtemp(prefix="mpi_opt_tpu_launch_")
+    os.makedirs(log_dir, exist_ok=True)
+
+    has_ckpt = _has_flag(rest, "--checkpoint-dir")
+    attempt = 0
+    while True:
+        rank_args = list(rest)
+        if attempt > 0 and has_ckpt and "--resume" not in rank_args:
+            # the restarted job continues from the last shared snapshot;
+            # --resume on an empty dir (crash before the first save)
+            # starts fresh, which is also correct
+            rank_args.append("--resume")
+        print(
+            json.dumps(
+                {
+                    "event": "launch",
+                    "attempt": attempt,
+                    "n_proc": args.n_proc,
+                    "log_dir": log_dir,
+                    "resume": "--resume" in rank_args,
+                }
+            ),
+            flush=True,
+        )
+        procs = _spawn_ranks(args.n_proc, rank_args, log_dir)
+        failed = _watch(procs, args.poll_interval)
+        if failed is None:
+            # success: re-surface rank 0's summary line as our own
+            with open(os.path.join(log_dir, "rank0.out")) as f:
+                lines = [l for l in f.read().splitlines() if l.strip()]
+            if lines:
+                print(lines[-1], flush=True)
+            print(
+                json.dumps({"event": "done", "attempts": attempt + 1}), flush=True
+            )
+            return 0
+        rc = procs[failed][0].returncode
+        with open(os.path.join(log_dir, f"rank{failed}.err")) as f:
+            tail = f.read()[-2000:]
+        if attempt >= args.retries:
+            print(
+                json.dumps(
+                    {
+                        "event": "failed",
+                        "rank": failed,
+                        "returncode": rc,
+                        "attempts": attempt + 1,
+                    }
+                ),
+                flush=True,
+            )
+            sys.stderr.write(
+                f"rank {failed} died (rc={rc}); retries exhausted. "
+                f"Last stderr:\n{tail}\n"
+            )
+            return 1
+        attempt += 1
+        print(
+            json.dumps(
+                {
+                    "event": "restart",
+                    "rank": failed,
+                    "returncode": rc,
+                    "attempt": attempt,
+                    "of": args.retries,
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,173 @@
+"""Coordinated multi-process recovery (VERDICT r4 missing #3).
+
+``mpi_opt_tpu.launch`` supervises an N-rank SPMD job: on any rank
+death it kills the survivors (mid-collective with a dead peer, they
+can never finish) and relaunches ALL ranks with ``--resume``, so the
+job continues from the last shared snapshot. The headline test
+SIGKILLs one rank mid-sweep and asserts the supervised job still
+completes with the bit-identical result of an unkilled run — the
+coordinated form of what test_fused_resume proves by hand.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mpi_opt_tpu import launch
+
+
+def _sweep_args(ck):
+    return [
+        "--workload", "fashion_mlp",
+        "--algorithm", "pbt",
+        "--fused",
+        "--population", "4",
+        "--generations", "4",
+        "--steps-per-generation", "2",
+        "--gen-chunk", "1",
+        "--n-data", "2",
+        "--seed", "0",
+        "--platform", "cpu",
+        "--local-devices", "2",
+        "--checkpoint-dir", ck,
+    ]
+
+
+def _run_supervisor(n_proc, retries, rank_args, log_dir, timeout=900):
+    p = subprocess.Popen(
+        [
+            sys.executable, "-m", "mpi_opt_tpu.launch",
+            "--n-proc", str(n_proc),
+            "--retries", str(retries),
+            "--log-dir", log_dir,
+            "--", *rank_args,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd="/root/repo",
+    )
+    out, err = p.communicate(timeout=timeout)
+    return p.returncode, out, err
+
+
+def _summary_line(out):
+    """The per-rank summary JSON the supervisor re-surfaces, stripped of
+    per-process wall-clock fields."""
+    for l in out.splitlines():
+        if l.startswith("{") and '"workload"' in l:
+            d = json.loads(l)
+            d.pop("wall_s", None)
+            d.pop("trials_per_sec_per_chip", None)
+            return d
+    raise AssertionError(f"no summary line in:\n{out}")
+
+
+def _find_rank_pid(marker, rank):
+    """PID of the spawned rank whose cmdline carries ``marker`` and
+    ``--process-id <rank>`` (the supervisor's grandchild)."""
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().decode(errors="replace").split("\x00")
+        except OSError:
+            continue
+        if marker in cmd and "--process-id" in cmd:
+            if cmd[cmd.index("--process-id") + 1] == str(rank):
+                return int(pid)
+    return None
+
+
+def _first_snapshot_exists(ck):
+    for root, dirs, files in os.walk(ck):
+        if "_CHECKPOINT_METADATA" in files:
+            return True
+    return False
+
+
+def test_supervisor_recovers_from_rank_kill_bit_identically(tmp_path):
+    ck_clean = str(tmp_path / "clean")
+    ck_kill = str(tmp_path / "kill")
+    logs_clean = str(tmp_path / "logs_clean")
+    logs_kill = str(tmp_path / "logs_kill")
+
+    # reference: an unkilled supervised run
+    rc, out, err = _run_supervisor(2, 0, _sweep_args(ck_clean), logs_clean)
+    assert rc == 0, f"{out}\n{err}"
+    ref = _summary_line(out)
+
+    # the killed run: SIGKILL rank 1 once the first snapshot committed
+    sup = subprocess.Popen(
+        [
+            sys.executable, "-m", "mpi_opt_tpu.launch",
+            "--n-proc", "2",
+            "--retries", "2",
+            "--log-dir", logs_kill,
+            "--", *_sweep_args(ck_kill),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd="/root/repo",
+    )
+    try:
+        deadline = time.time() + 600
+        killed = False
+        while not killed:
+            assert time.time() < deadline, "never reached first snapshot"
+            assert sup.poll() is None, sup.communicate()
+            if _first_snapshot_exists(ck_kill):
+                pid = _find_rank_pid(ck_kill, rank=1)
+                if pid is not None:
+                    os.kill(pid, signal.SIGKILL)
+                    killed = True
+                    continue
+            time.sleep(0.25)
+        out, err = sup.communicate(timeout=600)
+    finally:
+        if sup.poll() is None:
+            sup.kill()
+            sup.communicate()
+    assert sup.returncode == 0, f"{out}\n{err}"
+    events = [json.loads(l) for l in out.splitlines() if '"event"' in l]
+    assert any(e["event"] == "restart" for e in events), out
+    got = _summary_line(out)
+    assert got == ref, (got, ref)
+
+
+def test_supervisor_owns_bringup_flags(capsys):
+    with pytest.raises(SystemExit):
+        launch.main(["--n-proc", "2", "--", "--process-id", "0"])
+    assert "--process-id is owned by the supervisor" in capsys.readouterr().err
+
+
+def test_supervisor_requires_rank_args(capsys):
+    with pytest.raises(SystemExit):
+        launch.main(["--n-proc", "2"])
+    assert "after '--'" in capsys.readouterr().err
+
+
+def test_supervisor_surfaces_program_errors(tmp_path):
+    """A program bug (bad flag value) burns its retries fast and exits
+    nonzero with the rank's stderr — never loops forever."""
+    rc, out, err = _run_supervisor(
+        1,
+        1,
+        ["--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+         "--population", "4", "--generations", "0", "--no-mesh",
+         "--platform", "cpu"],
+        str(tmp_path / "logs"),
+        timeout=300,
+    )
+    assert rc == 1
+    events = [json.loads(l) for l in out.splitlines() if '"event"' in l]
+    assert [e["event"] for e in events].count("restart") == 1
+    assert events[-1]["event"] == "failed"
+    assert "generations" in err
