@@ -23,9 +23,10 @@ Per-config definitions (from BASELINE.json `configs`):
    end-to-end 256-trial search (suggest+train+report) trials/sec/chip.
 5. PBT population=1024, ResNet-18, CIFAR-100 — BASELINE puts this on a
    v4-32; one chip caps the resident population (models/resnet.py
-   documents the memory math: pop=64 with member_chunk=8 + remat fits a
-   16G v5e). Measured at the single-chip cap, reported per chip with
-   the cap stated.
+   documents the memory math: pop=64 with member_chunk=8 fits a 16G
+   v5e, stored-backward — remat off since round 5, an 18% win).
+   Measured at the single-chip cap, reported per chip with the cap
+   stated.
 """
 
 from __future__ import annotations
@@ -57,6 +58,34 @@ def median_walls(fn, repeats: int = 5):
         fn()
         walls.append(time.perf_counter() - t0)
     return statistics.median(walls), walls
+
+
+def timed_region(fn, warm_wall: float, min_s: float = 8.0, regions: int = 3):
+    """(median_region_wall, region_walls, k): run ``fn`` k times
+    back-to-back inside each timed region, k sized from the measured
+    warm wall so every region lasts >= ``min_s`` seconds.
+
+    VERDICT r4 weak #1: a sub-second timed sweep on this platform
+    measures launch amortization plus tunnel state, not sweep
+    throughput — per-launch jitter is 20-90 ms and the same code drew
+    30.8 vs 68.9 trials/s in different session windows. Stretching the
+    region to >= ~8 s of identical back-to-back sweeps makes the number
+    a steady-state throughput fact; the accounting is explicit
+    (value = k * n_trials / region_wall, k recorded as
+    ``sweeps_per_region``), and the median of ``regions`` regions with
+    all walls recorded keeps the residual spread visible.
+    """
+    import math
+    import statistics
+
+    k = max(1, math.ceil(min_s / max(warm_wall, 1e-3)))
+    walls = []
+    for _ in range(regions):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            fn()
+        walls.append(time.perf_counter() - t0)
+    return statistics.median(walls), walls, k
 
 
 def _tpu_setup():
@@ -117,24 +146,38 @@ def bench_config2(seed: int):
     t0 = time.perf_counter()
     res = fused_sha(wl, **kw)  # warmup: compile every rung's program pair
     log(f"[config2] warmup {time.perf_counter()-t0:.1f}s")
-    wall, walls = median_walls(lambda: fused_sha(wl, **kw))
+    t0 = time.perf_counter()
+    fused_sha(wl, **kw)
+    warm_wall = time.perf_counter() - t0
+    wall, walls, k = timed_region(lambda: fused_sha(wl, **kw), warm_wall)
 
     # driver path: same-seed warmup search compiles every (steps, pad)
     # group program the timed trajectory will hit; reset() (not reuse —
     # trial ids restart per algorithm and would warm-resume the warmup's
-    # states) makes the timed search bit-identical to a fresh backend's
+    # states) makes the timed search bit-identical to a fresh backend's.
+    # The timed region repeats the whole search (reset + run) to the
+    # same >= 5 s floor as the fused number; reset is host bookkeeping
+    # only (no device work), so the region measures search throughput.
     asha = lambda: get_algorithm("asha")(
         wl.default_space(), seed=seed, max_trials=64, min_budget=10, max_budget=270, eta=3
     )
     be = get_backend("tpu", wl, population=64, seed=seed)
     run_search(asha(), be)
+    t0 = time.perf_counter()
     be.reset()
     dres = run_search(asha(), be)
+    d_warm = time.perf_counter() - t0
+
+    def d_once():
+        be.reset()
+        return run_search(asha(), be)
+
+    d_wall, d_walls, d_k = timed_region(d_once, d_warm, min_s=5.0)
     be.close()
     return {
         "config": 2,
         "metric": "asha64_fashion_mlp_trials_per_sec_per_chip",
-        "value": round(res["n_trials"] / wall, 4),
+        "value": round(k * res["n_trials"] / wall, 4),
         "unit": "trials/sec/chip",
         "hardware": device,
         "rung_budgets": res["rung_budgets"],
@@ -142,12 +185,15 @@ def bench_config2(seed: int):
         "best_score": round(res["best_score"], 4),
         "wall_s": round(wall, 2),
         "wall_s_runs": [round(w, 2) for w in walls],
+        "sweeps_per_region": k,
         # completed-trials basis (n_trials / wall), comparable to the
         # fused number; rung re-evaluations are counted separately
-        "driver_trials_per_sec_per_chip": round(dres.n_trials / dres.wall_s, 4),
+        "driver_trials_per_sec_per_chip": round(d_k * dres.n_trials / d_wall, 4),
         "driver_n_evals": dres.n_evals,
         "driver_best_score": round(dres.best.score, 4),
-        "driver_wall_s": round(dres.wall_s, 2),
+        "driver_wall_s": round(d_wall, 2),
+        "driver_wall_s_runs": [round(w, 2) for w in d_walls],
+        "driver_sweeps_per_region": d_k,
     }
 
 
@@ -266,16 +312,27 @@ def bench_config4(seed: int):
     warm = algo_cls(space, seed=seed + 1, max_trials=192, budget=30)
     run_search(warm, be)  # compile train/eval + suggest programs outside the window
     be.reset()
+    t0 = time.perf_counter()
     algo = algo_cls(space, seed=seed, max_trials=256, budget=30)
     res = run_search(algo, be)
+    d_warm = time.perf_counter() - t0
+
+    def d_once():
+        be.reset()
+        return run_search(algo_cls(space, seed=seed, max_trials=256, budget=30), be)
+
+    d_wall, d_walls, d_k = timed_region(d_once, d_warm, min_s=5.0)
     be.close()  # release resident population state before config 5
 
     # (c) the fused path: buffer-resident generational TPE (same sweep)
     from mpi_opt_tpu.train.fused_tpe import fused_tpe
 
     fres = fused_tpe(wl, n_trials=256, batch=64, budget=30, seed=seed)  # warm
-    fused_wall, fused_walls = median_walls(
-        lambda: fused_tpe(wl, n_trials=256, batch=64, budget=30, seed=seed)
+    t0 = time.perf_counter()
+    fused_tpe(wl, n_trials=256, batch=64, budget=30, seed=seed)
+    f_warm = time.perf_counter() - t0
+    fused_wall, fused_walls, f_k = timed_region(
+        lambda: fused_tpe(wl, n_trials=256, batch=64, budget=30, seed=seed), f_warm
     )
     return {
         "config": 4,
@@ -283,18 +340,21 @@ def bench_config4(seed: int):
         # metric of record = the fused on-device sweep (as config 2's is
         # the fused SHA path); the generic driver+backend path is the
         # secondary number
-        "value": round(fres["n_trials"] / fused_wall, 4),
+        "value": round(f_k * fres["n_trials"] / fused_wall, 4),
         "unit": "trials/sec/chip",
         "hardware": device,
         "best_score": round(fres["best_score"], 4),
         "n_trials": fres["n_trials"],
         "wall_s": round(fused_wall, 2),
         "wall_s_runs": [round(w, 2) for w in fused_walls],
+        "sweeps_per_region": f_k,
         "acquisition_suggestions_per_sec": round(suggest_per_sec, 1),
         "acquisition_batch": n_suggest,
-        "driver_trials_per_sec_per_chip": round(res.trials_per_sec_per_chip, 4),
+        "driver_trials_per_sec_per_chip": round(d_k * res.n_trials / d_wall, 4),
         "driver_best_score": round(res.best.score, 4),
-        "driver_wall_s": round(res.wall_s, 2),
+        "driver_wall_s": round(d_wall, 2),
+        "driver_wall_s_runs": [round(w, 2) for w in d_walls],
+        "driver_sweeps_per_region": d_k,
     }
 
 

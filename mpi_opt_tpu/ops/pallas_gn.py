@@ -1,0 +1,246 @@
+"""Fused GroupNorm(+ReLU) as a Pallas TPU kernel, with custom VJP.
+
+Why this exists (PERF_NOTES round 4 → round 5): the config-3 ledger
+refuted a Pallas GN for the SmallCNN (C=32 pays a 4x lane-fill penalty
+and XLA was already within 1.33x of the 5-pass bandwidth floor), but
+flagged the calculus as different for C >= 128 — exactly ResNet-18's
+stages (64..512 channels). Two structural wins are available there:
+
+1. **Pass count.** XLA compiles GN fwd+bwd to ~6.7 full activation
+   passes (measured, probe_gn_floor2). This kernel's contract is the
+   analytic minimum: fwd reads x and writes y (2 passes; group stats
+   ride along in VMEM), bwd reads x and dy and writes dx (3 passes) —
+   the ReLU mask is RECOMPUTED from (x, mean, rstd, gamma, beta)
+   inside the bwd kernel instead of re-reading y, so the fused
+   GN+ReLU pair costs the same 5 passes a bare GN floors at.
+2. **Fusion.** ReLU (and its backward mask) disappears into the same
+   passes — XLA fuses elementwise chains well, but the relu backward's
+   extra y read survives in its schedules.
+
+Layout: channel-last ``[B, H, W, C]`` activations (the models-package
+convention), one sample per grid step; the whole per-sample activation
+fits VMEM at every ResNet-18 stage (max 128 KB bf16 at stage 0).
+Stats are computed in f32 regardless of the activation dtype (same as
+``flax.linen.GroupNorm``'s default promotion). ``C % num_groups == 0``
+is required, as in flax.
+
+``pl.pallas_call`` has a batching rule, so the population trainer's
+``vmap`` over members simply prepends a grid dimension — one kernel
+serves the vmapped population path unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_INTERPRET = False  # tests flip this for CPU interpret-mode runs
+
+
+def _group_matrices(c: int, groups: int):
+    """(M [c,g], MT [g,c]) 0/1 group-membership matrices, built from
+    2-D iota inside the kernel. Grouped channel reductions become tiny
+    f32 matmuls ([1,c]@[c,g] collapse, [1,g]@[g,c] broadcast-back):
+    Mosaic cannot shape-cast the LANE dimension (reshape [s,c] ->
+    [s,g,gs] fails to lower), and matmul against a membership matrix is
+    both supported and exact in f32."""
+    gs = c // groups
+    ci = jax.lax.broadcasted_iota(jnp.int32, (c, groups), 0)
+    gi = jax.lax.broadcasted_iota(jnp.int32, (c, groups), 1)
+    m = (ci // gs == gi).astype(jnp.float32)
+    cit = jax.lax.broadcasted_iota(jnp.int32, (groups, c), 1)
+    git = jax.lax.broadcasted_iota(jnp.int32, (groups, c), 0)
+    mt = (cit // gs == git).astype(jnp.float32)
+    return m, mt
+
+
+def _fwd_kernel(x_ref, gamma_ref, beta_ref, y_ref, mean_ref, rstd_ref,
+                *, groups: int, eps: float, relu: bool):
+    """One block of B samples: y = [relu](gn(x)); per-sample group
+    stats ride along ([B,s,c] blocks — per-sample grids drowned in
+    grid-step overhead, measured 2.2x WORSE end-to-end)."""
+    bb, s, c = x_ref.shape
+    x = x_ref[:].astype(jnp.float32)  # [bb, s, c]
+    m, mt = _group_matrices(c, groups)
+    n = s * (c // groups)
+    colsum = jnp.sum(x, axis=1)  # [bb, c]
+    colsq = jnp.sum(jnp.square(x), axis=1)
+    mean = jnp.dot(colsum, m, preferred_element_type=jnp.float32) / n  # [bb, g]
+    var = jnp.dot(colsq, m, preferred_element_type=jnp.float32) / n - jnp.square(mean)
+    rstd = jax.lax.rsqrt(var + eps)
+    meanc = jnp.dot(mean, mt, preferred_element_type=jnp.float32)  # [bb, c]
+    rstdc = jnp.dot(rstd, mt, preferred_element_type=jnp.float32)
+    gamma = gamma_ref[:].astype(jnp.float32)  # [1, c]
+    beta = beta_ref[:].astype(jnp.float32)
+    y = (x - meanc[:, None, :]) * rstdc[:, None, :] * gamma[None, :, :] + beta[None, :, :]
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    y_ref[:] = y.astype(y_ref.dtype)
+    mean_ref[:] = mean.reshape(bb, 1, groups)
+    rstd_ref[:] = rstd.reshape(bb, 1, groups)
+
+
+def _bwd_kernel(x_ref, dy_ref, gamma_ref, beta_ref, mean_ref, rstd_ref,
+                dx_ref, dgamma_ref, dbeta_ref,
+                *, groups: int, relu: bool):
+    """One sample: dx plus THIS sample's dgamma/dbeta partials.
+
+    The ReLU mask is recomputed from the saved stats (z > 0 with
+    z = gamma*xhat + beta) rather than re-read from y — that is the
+    pass the fusion saves.
+    """
+    bb, s, c = x_ref.shape
+    x = x_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    gamma = gamma_ref[:].astype(jnp.float32)  # [1, c]
+    m, mt = _group_matrices(c, groups)
+    n = s * (c // groups)
+    mean = mean_ref[:].reshape(bb, groups)
+    rstd = rstd_ref[:].reshape(bb, groups)
+    meanc = jnp.dot(mean, mt, preferred_element_type=jnp.float32)[:, None, :]
+    rstdc = jnp.dot(rstd, mt, preferred_element_type=jnp.float32)[:, None, :]
+    xhat = (x - meanc) * rstdc
+    if relu:
+        z = xhat * gamma[None, :, :] + beta_ref[:].astype(jnp.float32)[None, :, :]
+        dy = jnp.where(z > 0.0, dy, 0.0)
+    dgamma_ref[:] = jnp.sum(dy * xhat, axis=1).reshape(bb, 1, c)
+    dbeta_ref[:] = jnp.sum(dy, axis=1).reshape(bb, 1, c)
+    # dz = dy * gamma; per group: dx = rstd*(dz - mean(dz) - xhat*mean(dz*xhat))
+    dz = dy * gamma[None, :, :]
+    s1 = jnp.dot(jnp.sum(dz, axis=1), m, preferred_element_type=jnp.float32)
+    s2 = jnp.dot(jnp.sum(dz * xhat, axis=1), m, preferred_element_type=jnp.float32)
+    m1c = jnp.dot(s1 / n, mt, preferred_element_type=jnp.float32)[:, None, :]
+    m2c = jnp.dot(s2 / n, mt, preferred_element_type=jnp.float32)[:, None, :]
+    dx = rstdc * (dz - m1c - xhat * m2c)
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+
+
+def _flatten(x):
+    b = x.shape[0]
+    c = x.shape[-1]
+    return x.reshape(b, -1, c)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def group_norm_relu(x, gamma, beta, groups: int = 32, eps: float = 1e-6,
+                    relu: bool = True):
+    """Fused GroupNorm(+ReLU) over channel-last ``[B, ..., C]``."""
+    y, _, _ = _forward(x, gamma, beta, groups, eps, relu)
+    return y
+
+
+def _block_rows(b: int, s: int, c: int, elems: int = 1 << 19) -> int:
+    """Samples per block: the largest divisor of b keeping the block's
+    f32 working set near ~4 MB of VMEM (x + y + temporaries fit the
+    ~16 MB budget with double buffering)."""
+    # elems: per-buffer f32 element budget. Measured ceilings on the
+    # v5e's 16 MB scoped vmem: fwd [16,1024,64] OOMed at 16.03M (so
+    # fwd runs at 1<<19 ~ 2MB/buffer); bwd carries x AND dy AND dx
+    # plus their f32 copies and OOMed at 23.7M with fwd's budget, so
+    # it runs at 1<<18
+    target = max(1, elems // (s * c))
+    bb = 1
+    for cand in range(1, b + 1):
+        if b % cand == 0 and cand <= target:
+            bb = cand
+    return bb
+
+
+def _forward(x, gamma, beta, groups, eps, relu):
+    xf = _flatten(x)
+    b, s, c = xf.shape
+    bb = _block_rows(b, s, c)
+    g2 = gamma.reshape(1, c)
+    b2 = beta.reshape(1, c)
+    y, mean, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel, groups=groups, eps=eps, relu=relu),
+        grid=(b // bb,),
+        in_specs=[
+            pl.BlockSpec((bb, s, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bb, s, c), lambda i: (i, 0, 0)),
+            # singleton middle axis: Mosaic requires the block's last
+            # two dims to be (8,128)-divisible OR equal to the array's —
+            # [b,1,G] blocks as (bb,1,G) satisfy the 'equal' arm (and
+            # keep doing so under vmap's prepended member dimension)
+            pl.BlockSpec((bb, 1, groups), lambda i: (i, 0, 0)),
+            pl.BlockSpec((bb, 1, groups), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s, c), x.dtype),
+            jax.ShapeDtypeStruct((b, 1, groups), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1, groups), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(xf, g2, b2)
+    return y.reshape(x.shape), mean, rstd
+
+
+def _fwd_rule(x, gamma, beta, groups, eps, relu):
+    y, mean, rstd = _forward(x, gamma, beta, groups, eps, relu)
+    return y, (x, gamma, beta, mean, rstd)
+
+
+def _bwd_rule(groups, eps, relu, res, dy):
+    x, gamma, beta, mean, rstd = res
+    xf = _flatten(x)
+    dyf = _flatten(dy)
+    b, s, c = xf.shape
+    bb = _block_rows(b, s, c, elems=1 << 18)
+    g2 = gamma.reshape(1, c)
+    be2 = beta.reshape(1, c)
+    dx, dgamma, dbeta = pl.pallas_call(
+        functools.partial(_bwd_kernel, groups=groups, relu=relu),
+        grid=(b // bb,),
+        in_specs=[
+            pl.BlockSpec((bb, s, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((bb, s, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((bb, 1, groups), lambda i: (i, 0, 0)),
+            pl.BlockSpec((bb, 1, groups), lambda i: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bb, s, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((bb, 1, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((bb, 1, c), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s, c), x.dtype),
+            jax.ShapeDtypeStruct((b, 1, c), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1, c), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(xf, dyf, g2, be2, mean, rstd)
+    # the tiny [B, C] partial reduction stays in XLA: it is bytes-free
+    # relative to the activation passes and fuses with whatever follows
+    return (
+        dx.reshape(x.shape),
+        jnp.sum(dgamma, axis=(0, 1)).astype(gamma.dtype),
+        jnp.sum(dbeta, axis=(0, 1)).astype(beta.dtype),
+    )
+
+
+group_norm_relu.defvjp(_fwd_rule, _bwd_rule)
+
+
+def reference_group_norm_relu(x, gamma, beta, groups=32, eps=1e-6, relu=True):
+    """Pure-jnp reference for correctness tests."""
+    b = x.shape[0]
+    c = x.shape[-1]
+    xf = x.reshape(b, -1, c).astype(jnp.float32)
+    s = xf.shape[1]
+    xg = xf.reshape(b, s, groups, c // groups)
+    mean = xg.mean(axis=(1, 3), keepdims=True)
+    var = xg.var(axis=(1, 3), keepdims=True)
+    xhat = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(b, s, c)
+    y = xhat * gamma.astype(jnp.float32) + beta.astype(jnp.float32)
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.reshape(x.shape).astype(x.dtype)

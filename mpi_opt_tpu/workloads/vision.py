@@ -67,19 +67,42 @@ class Cifar100ResNet18(_VisionWorkload):
 
     The full population only fits HBM sharded over a mesh's 'pop' axis
     or capped per chip — see models/resnet.py for the memory math.
-    ``remat`` (on by default) bounds activation memory so the population
-    cap is set by param+momentum residency, not by the backward pass;
-    ``width``/``stage_sizes`` shrink the model for CPU-mesh dry runs.
+    ``remat`` is OFF by default since round 5: at the measured
+    single-chip envelope (pop<=64, member_chunk=8) the stored-backward
+    activations fit alongside the pool, and dropping the recompute is
+    an 18% segment-wall win (18.98 -> 15.53 s; full fused 2-gen sweep
+    42.1 -> 35.3 s, PERF_NOTES round 5). Turn it back on for heavier
+    per-chip loads (bigger member_chunk x batch, or if a future chip
+    cap raises the resident population). ``width``/``stage_sizes``
+    shrink the model for CPU-mesh dry runs.
     """
 
     name = "cifar100_resnet18"
     dataset = "cifar100"
     batch_size = 128
 
-    def __init__(self, n_train=None, n_val=None, width: int = 64, remat: bool = True):
+    def __init__(
+        self,
+        n_train=None,
+        n_val=None,
+        width: int = 64,
+        remat: bool = False,
+        pallas_gn: bool = False,
+    ):
         super().__init__(n_train=n_train, n_val=n_val)
         self.width = width
         self.remat = remat
+        # pallas_gn swaps nn.GroupNorm for the fused Pallas GN+ReLU
+        # kernel (ops/pallas_gn.py). Constructor-only, no env hook: a
+        # hidden env switch could silently change model numerics across
+        # a checkpoint resume (the param trees are identical by design,
+        # so nothing would refuse). Measured 1.86x SLOWER than XLA's GN
+        # at these shapes (PERF_NOTES round 5) — kept as the tested
+        # Pallas exhibit, not a recommended path.
+        self.pallas_gn = pallas_gn
 
     def _model(self, n_classes):
-        return ResNet18(n_classes=n_classes, width=self.width, remat=self.remat)
+        return ResNet18(
+            n_classes=n_classes, width=self.width, remat=self.remat,
+            pallas_gn=self.pallas_gn,
+        )
