@@ -331,10 +331,19 @@ def _bracket_cohort(checkpoint_dir, b: int, n: int, tag: str, cohort_fn):
             return cohort, n_model
     cohort, n_model = cohort_fn(b, n)
     if path is not None:
+        import jax
+
         os.makedirs(checkpoint_dir, exist_ok=True)
         # write-then-rename: a crash mid-write must not leave a torn
-        # cohort file that a resume would trust
-        tmp = path + ".tmp"
+        # cohort file that a resume would trust. The tmp name is
+        # RANK-UNIQUE: under multi-process SPMD every rank runs this
+        # host code against the SHARED checkpoint dir, and two ranks
+        # sharing one tmp path race each other (one rank's os.replace
+        # steals the other's half-written file; the loser's replace
+        # then raises FileNotFoundError). Ranks write identical bytes
+        # (the cohort is drawn by deterministic SPMD-identical host
+        # code), so last-replace-wins is correct.
+        tmp = f"{path}.tmp{jax.process_index()}"
         with open(tmp, "wb") as f:
             np.savez(f, cohort=cohort, n_model=n_model, tag=np.asarray(tag))
         os.replace(tmp, path)

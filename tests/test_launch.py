@@ -52,7 +52,14 @@ def _run_supervisor(n_proc, retries, rank_args, log_dir, timeout=900):
         text=True,
         cwd="/root/repo",
     )
-    out, err = p.communicate(timeout=timeout)
+    try:
+        out, err = p.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        # kill the supervisor rather than leak it + its rank
+        # grandchildren into the rest of the xdist worker's session
+        p.kill()
+        p.communicate()
+        raise
     return p.returncode, out, err
 
 
@@ -140,6 +147,25 @@ def test_supervisor_recovers_from_rank_kill_bit_identically(tmp_path):
     assert any(e["event"] == "restart" for e in events), out
     got = _summary_line(out)
     assert got == ref, (got, ref)
+
+
+def test_supervisor_single_rank_degenerate_case(tmp_path):
+    """--n-proc 1 is the degenerate gang: one rank with the bring-up
+    trio (num_processes=1 through jax.distributed), still supervised.
+    A user scaling a launch script down to one host must not need a
+    different command."""
+    rc, out, err = _run_supervisor(
+        1,
+        0,
+        ["--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+         "--population", "4", "--generations", "1",
+         "--steps-per-generation", "2", "--no-mesh", "--platform", "cpu"],
+        str(tmp_path / "logs"),
+        timeout=600,
+    )
+    assert rc == 0, f"{out}\n{err}"
+    s = _summary_line(out)
+    assert s["n_trials"] == 4 and s["best_score"] is not None
 
 
 def test_supervisor_owns_bringup_flags(capsys):

@@ -134,7 +134,13 @@ def test_two_process_driver_slot_pool_agrees():
 # of its own) and runs a fused sweep end-to-end; both ranks must print
 # the identical summary JSON.
 
-_CLI_WORKER = r"""
+# shared scaffolding for workers that go through the CLI user surface:
+# capture the summary JSON, assert bring-up REALLY spanned 2 processes
+# (identical per-rank output alone would also be produced by two
+# silently-independent single-process runs with the same seed), strip
+# the per-process wall-clock fields, and print under ``tag``. The
+# algorithm-specific argv is spliced in via %(argv)s.
+_CLI_TEMPLATE = r"""
 import io
 import json
 import sys
@@ -146,6 +152,7 @@ jax.config.update("jax_num_cpu_devices", 2)
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cpu")
 
 pid, port = int(sys.argv[1]), sys.argv[2]
+extra = sys.argv[3:]
 
 from mpi_opt_tpu import cli
 
@@ -155,16 +162,13 @@ sys.stdout = buf
 try:
     rc = cli.main([
         "--workload", "fashion_mlp",
-        "--algorithm", "pbt",
-        "--fused",
-        "--population", "4",
-        "--generations", "2",
-        "--steps-per-generation", "2",
         "--n-data", "2",
         "--seed", "0",
         "--coordinator", f"127.0.0.1:{port}",
         "--num-processes", "2",
         "--process-id", str(pid),
+        %(argv)s
+        *extra,
     ])
 finally:
     sys.stdout = real_stdout
@@ -176,13 +180,45 @@ assert summary["n_chips"] == 4, summary
 # wall-clock is measured per process; every SEARCH field must agree
 for k in ("wall_s", "trials_per_sec_per_chip"):
     del summary[k]
-print(f"CLI {pid} {json.dumps(summary, sort_keys=True)}", flush=True)
+print(f"%(tag)s {pid} {json.dumps(summary, sort_keys=True)}", flush=True)
 """
+
+
+def _cli_worker(tag, argv):
+    return _CLI_TEMPLATE % {
+        "tag": tag,
+        "argv": "".join(f"{a!r}, " for a in argv),
+    }
+
+
+_CLI_WORKER = _cli_worker(
+    "CLI",
+    ["--algorithm", "pbt", "--fused", "--population", "4",
+     "--generations", "2", "--steps-per-generation", "2"],
+)
 
 
 def test_two_process_cli_bringup_end_to_end():
     outs = _run_two_procs(_CLI_WORKER)
     a, b = _tagged(outs, "CLI")
+    assert a == b, outs
+
+
+_CLI_BOHB_WORKER = _cli_worker(
+    "CLIBOHB",
+    ["--algorithm", "bohb", "--fused", "--max-budget", "4", "--eta", "2",
+     "--checkpoint-dir"],  # the shared dir arrives as the extra argv
+)
+
+
+def test_two_process_cli_fused_bohb_with_shared_checkpoints(tmp_path):
+    """The full composition a v4-32 BOHB user runs: the CLI brings up
+    SPMD, the model-based fused brackets write per-bracket checkpoints
+    + persisted cohorts to a SHARED directory under orbax's multihost
+    coordination, and both ranks print the identical summary."""
+    ck = str(tmp_path / "bohb_cli_ck")
+    outs = _run_two_procs(_CLI_BOHB_WORKER, extra_args=(ck,), timeout=600)
+    a, b = _tagged(outs, "CLIBOHB")
     assert a == b, outs
 
 
