@@ -187,6 +187,24 @@ def main(argv=None) -> int:
         rc = procs[failed][0].returncode
         with open(os.path.join(log_dir, f"rank{failed}.err")) as f:
             tail = f.read()[-2000:]
+        if rc == 2:
+            # argparse usage error: deterministic, and retrying would be
+            # actively wrong — e.g. the CLI's stale-checkpoint-dir
+            # refusal (exit 2) would be "recovered" by the retry's
+            # --resume into silently replaying the old sweep, the exact
+            # accident that refusal exists to stop. Surface it instead.
+            print(
+                json.dumps(
+                    {"event": "failed", "rank": failed, "returncode": rc,
+                     "attempts": attempt + 1, "usage_error": True}
+                ),
+                flush=True,
+            )
+            sys.stderr.write(
+                f"rank {failed} rejected its arguments (rc=2); not "
+                f"retrying a usage error. Stderr:\n{tail}\n"
+            )
+            return 1
         if attempt >= args.retries:
             print(
                 json.dumps(

@@ -331,8 +331,6 @@ def _bracket_cohort(checkpoint_dir, b: int, n: int, tag: str, cohort_fn):
             return cohort, n_model
     cohort, n_model = cohort_fn(b, n)
     if path is not None:
-        import jax
-
         os.makedirs(checkpoint_dir, exist_ok=True)
         # write-then-rename: a crash mid-write must not leave a torn
         # cohort file that a resume would trust. The tmp name is

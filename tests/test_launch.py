@@ -55,10 +55,16 @@ def _run_supervisor(n_proc, retries, rank_args, log_dir, timeout=900):
     try:
         out, err = p.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
-        # kill the supervisor rather than leak it + its rank
-        # grandchildren into the rest of the xdist worker's session
-        p.kill()
-        p.communicate()
+        # SIGINT first: KeyboardInterrupt unwinds launch.main through
+        # _watch's finally, which _kill_all's the rank grandchildren —
+        # a bare SIGKILL would skip that cleanup and leak the ranks
+        # into the rest of the xdist worker's session
+        p.send_signal(signal.SIGINT)
+        try:
+            p.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
         raise
     return p.returncode, out, err
 
@@ -178,6 +184,40 @@ def test_supervisor_requires_rank_args(capsys):
     with pytest.raises(SystemExit):
         launch.main(["--n-proc", "2"])
     assert "after '--'" in capsys.readouterr().err
+
+
+def test_supervisor_never_converts_stale_dir_refusal_into_resume(tmp_path):
+    """A pre-existing snapshot in --checkpoint-dir makes the CLI refuse
+    (exit 2) unless --resume was passed. The supervisor must NOT 'fix'
+    that by retrying with --resume appended — that would silently
+    replay the old sweep, the accident the refusal exists to stop."""
+    ck = str(tmp_path / "stale")
+    # seed the dir with a real snapshot from a prior supervised run
+    rc, out, err = _run_supervisor(
+        1, 0,
+        ["--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+         "--population", "4", "--generations", "1",
+         "--steps-per-generation", "2", "--gen-chunk", "1", "--no-mesh",
+         "--platform", "cpu", "--checkpoint-dir", ck],
+        str(tmp_path / "logs1"),
+        timeout=600,
+    )
+    assert rc == 0, f"{out}\n{err}"
+    # a NEW supervised job pointed at the stale dir, retries available
+    rc, out, err = _run_supervisor(
+        1, 3,
+        ["--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+         "--population", "4", "--generations", "1",
+         "--steps-per-generation", "2", "--gen-chunk", "1", "--no-mesh",
+         "--platform", "cpu", "--checkpoint-dir", ck],
+        str(tmp_path / "logs2"),
+        timeout=600,
+    )
+    assert rc == 1
+    events = [json.loads(l) for l in out.splitlines() if '"event"' in l]
+    assert not any(e["event"] == "restart" for e in events), out
+    assert events[-1].get("usage_error") is True, events
+    assert "already holds a sweep snapshot" in err
 
 
 def test_supervisor_surfaces_program_errors(tmp_path):
