@@ -186,6 +186,12 @@ def test_supervisor_requires_rank_args(capsys):
     assert "after '--'" in capsys.readouterr().err
 
 
+def test_supervisor_rejects_nonpositive_n_proc(capsys):
+    with pytest.raises(SystemExit):
+        launch.main(["--n-proc", "0", "--", "--workload", "digits"])
+    assert "--n-proc must be >= 1" in capsys.readouterr().err
+
+
 def test_supervisor_never_converts_stale_dir_refusal_into_resume(tmp_path):
     """A pre-existing snapshot in --checkpoint-dir makes the CLI refuse
     (exit 2) unless --resume was passed. The supervisor must NOT 'fix'

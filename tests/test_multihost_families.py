@@ -175,8 +175,14 @@ finally:
 assert rc == 0, buf.getvalue()
 assert jax.process_count() == 2, jax.process_count()
 summary = json.loads(buf.getvalue().strip().splitlines()[-1])
-assert summary["mesh"] == {"pop": 2, "data": 2}, summary
-assert summary["n_chips"] == 4, summary
+# fused summaries carry the mesh; the driver path builds its mesh
+# inside the backend and reports without these keys. Keyed on the
+# backend field (present in BOTH shapes) so a fused-summary refactor
+# that dropped the mesh key would FAIL here, not silently skip the
+# one assertion proving bring-up really spanned 2x2 devices
+if summary["backend"] == "fused":
+    assert summary["mesh"] == {"pop": 2, "data": 2}, summary
+    assert summary["n_chips"] == 4, summary
 # wall-clock is measured per process; every SEARCH field must agree
 for k in ("wall_s", "trials_per_sec_per_chip"):
     del summary[k]
@@ -209,6 +215,22 @@ _CLI_BOHB_WORKER = _cli_worker(
     ["--algorithm", "bohb", "--fused", "--max-budget", "4", "--eta", "2",
      "--checkpoint-dir"],  # the shared dir arrives as the extra argv
 )
+
+_CLI_DRIVER_WORKER = _cli_worker(
+    "CLIDRIVER",
+    ["--algorithm", "asha", "--backend", "tpu", "--trials", "8",
+     "--min-budget", "2", "--max-budget", "4", "--eta", "2",
+     "--population", "4"],
+)
+
+
+def test_two_process_cli_driver_backend():
+    """The driver (non-fused) surface across processes: host ASHA on
+    the slot-pool backend, launched purely through the CLI — the last
+    family x surface cell of the multi-host matrix."""
+    outs = _run_two_procs(_CLI_DRIVER_WORKER)
+    a, b = _tagged(outs, "CLIDRIVER")
+    assert a == b, outs
 
 
 def test_two_process_cli_fused_bohb_with_shared_checkpoints(tmp_path):
