@@ -174,12 +174,17 @@ finally:
     sys.stdout = real_stdout
 assert rc == 0, buf.getvalue()
 assert jax.process_count() == 2, jax.process_count()
+# the federated world must hold BOTH ranks' devices — process_count
+# alone plus identical outputs would also pass if the mesh silently
+# degraded to each rank's 2 local devices
+assert jax.device_count() == 4, jax.device_count()
 summary = json.loads(buf.getvalue().strip().splitlines()[-1])
 # fused summaries carry the mesh; the driver path builds its mesh
 # inside the backend and reports without these keys. Keyed on the
-# backend field (present in BOTH shapes) so a fused-summary refactor
-# that dropped the mesh key would FAIL here, not silently skip the
-# one assertion proving bring-up really spanned 2x2 devices
+# backend field (present in BOTH shapes), with the value pinned to the
+# known set so a renamed backend tag fails loudly instead of silently
+# skipping the mesh assertions
+assert summary["backend"] in ("fused", "tpu", "cpu"), summary
 if summary["backend"] == "fused":
     assert summary["mesh"] == {"pop": 2, "data": 2}, summary
     assert summary["n_chips"] == 4, summary
