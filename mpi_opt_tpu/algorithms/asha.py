@@ -82,6 +82,16 @@ class ASHA(Algorithm):
 
     def report_batch(self, results: Sequence[TrialResult]):
         for r in results:
+            if not r.ok:
+                # the failed trial leaves the rung race entirely: it is
+                # discarded from _outstanding (so finished() can close
+                # without waiting on it forever), never enters
+                # rung_scores (a NaN there would promote — NaN compares
+                # false against everything, so it always looks top-k),
+                # and is never promotable
+                self._outstanding.discard(r.trial_id)
+                self._mark_failed(r)
+                continue
             t = self.trials[r.trial_id]
             self._outstanding.discard(r.trial_id)
             t.record(r.score, r.step)

@@ -122,8 +122,25 @@ class Algorithm(abc.ABC):
             t.trial_id for t in self.trials.values() if t.status == TrialStatus.RUNNING
         ]
 
+    def _mark_failed(self, r: TrialResult) -> Trial:
+        """Shared failed-report bookkeeping: flag the trial FAILED and
+        keep the error visible on the ledger. The trial's score is NOT
+        recorded (a failed result's score is NaN-family by contract), so
+        ``best()`` can never surface it."""
+        t = self.trials[r.trial_id]
+        t.status = TrialStatus.FAILED
+        t.error = r.error
+        return t
+
     def best(self) -> Optional[Trial]:
-        scored = [t for t in self.trials.values() if t.score is not None]
+        # FAILED trials are excluded even when an earlier rung left a
+        # finite score behind: a trial whose latest evaluation failed is
+        # not a result an operator can act on
+        scored = [
+            t
+            for t in self.trials.values()
+            if t.score is not None and t.status != TrialStatus.FAILED
+        ]
         return best_finite(scored, key=lambda t: t.score)
 
     @property
@@ -145,6 +162,7 @@ class Algorithm(abc.ABC):
                     "status": t.status.value,
                     "score": t.score,
                     "history": t.history,
+                    "error": t.error,
                 }
                 for t in self.trials.values()
             ],
@@ -166,4 +184,5 @@ class Algorithm(abc.ABC):
             )
             t.score = rec["score"]
             t.history = [tuple(h) for h in rec["history"]]
+            t.error = rec.get("error")  # pre-upgrade checkpoints: None
             self.trials[t.trial_id] = t

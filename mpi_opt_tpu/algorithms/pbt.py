@@ -121,6 +121,18 @@ class PBT(Algorithm):
 
     def report_batch(self, results: Sequence[TrialResult]):
         for r in results:
+            if not r.ok:
+                # a failed member scores -inf for the generation: it
+                # ranks at the bottom of the exploit cut (rank_descending
+                # sorts -inf last), so the next generation REPLACES it —
+                # hparams and state copied from a surviving winner. NaN
+                # would be wrong here: it also sorts last under argsort,
+                # but any downstream arithmetic on the score vector
+                # would propagate it
+                t = self._mark_failed(r)
+                self._pending.discard(r.trial_id)
+                self._gen_scores[t.params["__slot__"]] = -np.inf
+                continue
             t = self.trials[r.trial_id]
             t.record(r.score, r.step)
             t.status = TrialStatus.DONE

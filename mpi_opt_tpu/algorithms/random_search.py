@@ -41,6 +41,13 @@ class RandomSearch(Algorithm):
 
     def report_batch(self, results: Sequence[TrialResult]):
         for r in results:
+            if not r.ok:
+                # a failed trial still consumed its suggestion slot: it
+                # counts toward completion so the search terminates, it
+                # just never scores (best() skips FAILED)
+                self._mark_failed(r)
+                self._done += 1
+                continue
             t = self.trials[r.trial_id]
             t.record(r.score, r.step)
             t.status = TrialStatus.DONE

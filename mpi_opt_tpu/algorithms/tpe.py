@@ -87,6 +87,13 @@ class TPE(Algorithm):
 
     def report_batch(self, results: Sequence[TrialResult]):
         for r in results:
+            if not r.ok:
+                # failed trials never enter the observation ring: a NaN
+                # score would poison the Parzen moments, and counting it
+                # toward n_startup would engage the surrogate on garbage
+                self._mark_failed(r)
+                self._done += 1
+                continue
             t = self.trials[r.trial_id]
             t.record(r.score, r.step)
             t.status = TrialStatus.DONE

@@ -56,6 +56,13 @@ class Backend(abc.ABC):
         Trials may carry ``params['__inherit_from__']`` (PBT weight
         inheritance) and cumulative budgets (ASHA promotions); stateful
         backends honor both, stateless backends retrain from scratch.
+
+        Failure contract: one trial failing must not poison the batch —
+        a raising/hanging/diverging trial comes back as a non-ok
+        TrialResult (``status`` failed/timeout, NaN-family score,
+        ``error`` set; see trial.failed_result), never as a raised
+        exception, so the driver's FailurePolicy can retry or report it
+        while the rest of the batch's results stand.
         """
 
     def close(self) -> None:

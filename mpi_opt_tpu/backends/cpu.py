@@ -19,6 +19,7 @@ Two paths:
 
 from __future__ import annotations
 
+import math
 import multiprocessing as mp
 import os
 import time
@@ -26,7 +27,7 @@ from collections import OrderedDict
 from typing import Any, Sequence
 
 from mpi_opt_tpu.backends.base import Backend, register_backend
-from mpi_opt_tpu.trial import Trial, TrialResult
+from mpi_opt_tpu.trial import Trial, TrialResult, failed_result
 from mpi_opt_tpu.workloads.base import Workload
 
 _WORKER_WORKLOAD: Workload | None = None
@@ -70,12 +71,34 @@ def _init_pool_worker(workload_name: str, workload_kwargs: dict):
 
 
 def _eval_one(args):
+    """Evaluate one job, NEVER letting a trial's exception escape the
+    worker: a raising trial poisons pool.map's whole batch (every other
+    job's result is discarded with it), so the failure is materialized
+    as a failed TrialResult right where it happens. Non-finite scores
+    are mapped onto the same contract — the host driver path's
+    equivalent of the fused sweeps' isfinite masking."""
     trial_id, params, budget, seed = args
     t0 = time.perf_counter()
-    score = _WORKER_WORKLOAD.evaluate(params, budget, seed)
+    try:
+        score = float(_WORKER_WORKLOAD.evaluate(params, budget, seed))
+    except Exception as e:
+        return failed_result(
+            trial_id,
+            budget,
+            f"{type(e).__name__}: {e}",
+            wall_time=time.perf_counter() - t0,
+        )
+    if not math.isfinite(score):
+        return failed_result(
+            trial_id,
+            budget,
+            f"non-finite score {score!r}",
+            score=score,
+            wall_time=time.perf_counter() - t0,
+        )
     return TrialResult(
         trial_id=trial_id,
-        score=float(score),
+        score=score,
         step=budget,
         wall_time=time.perf_counter() - t0,
     )
@@ -92,13 +115,18 @@ class CPUBackend(Backend):
         seed: int = 0,
         workload_kwargs: dict | None = None,
         max_states: int = 256,
+        trial_timeout: float | None = None,  # seconds per trial, None = unbounded
     ):
         super().__init__(workload)
         self.n_workers = n_workers or (os.cpu_count() or 1)
         self.seed = seed
+        if trial_timeout is not None and trial_timeout <= 0:
+            raise ValueError(f"trial_timeout must be > 0, got {trial_timeout}")
+        self.trial_timeout = trial_timeout
         self._workload_kwargs = workload_kwargs or {}
         self._pool = None
         self._warned_stateful_platform = False
+        self._warned_stateful_timeout = False
         # trial_id -> training state, FIFO-bounded: PBT mints fresh trial
         # ids every generation and would otherwise accumulate every
         # generation's model states until OOM (inheritance only ever
@@ -127,14 +155,100 @@ class CPUBackend(Backend):
         if self.workload.stateful:
             # stateful path: warm resumes + PBT inheritance need the
             # state store, which lives in this process
+            if self.trial_timeout is not None and not self._warned_stateful_timeout:
+                # in-parent execution cannot be interrupted, so the
+                # deadline the user asked for is unenforceable here —
+                # say so instead of silently pretending it's active
+                self._warned_stateful_timeout = True
+                import warnings
+
+                warnings.warn(
+                    "cpu backend: trial_timeout cannot be enforced for "
+                    "stateful workloads (they evaluate in-parent, and an "
+                    "in-process call can't be interrupted) — exceptions "
+                    "and non-finite scores are still caught, hangs are "
+                    "not reaped",
+                    stacklevel=3,
+                )
             return [self._evaluate_stateful(t) for t in trials]
         jobs = [
             (t.trial_id, _clean(t.params), t.budget, self.seed) for t in trials
         ]
-        if (self.n_workers == 1 or len(jobs) == 1) and self._inline_ok():
+        # a timeout can only be enforced across a process boundary (a
+        # hung in-parent call can't be interrupted), so it forces the
+        # pool path even for single-trial batches
+        if (
+            self.trial_timeout is None
+            and (self.n_workers == 1 or len(jobs) == 1)
+            and self._inline_ok()
+        ):
             self._ensure_inline_worker()
             return [_eval_one(j) for j in jobs]
-        return list(self._get_pool().map(_eval_one, jobs))
+        return self._evaluate_pool(jobs)
+
+    def _evaluate_pool(self, jobs) -> list[TrialResult]:
+        """Per-job async dispatch: one trial raising (caught in-worker)
+        never takes the rest of the batch with it — pool.map would
+        discard every result on the first exception. Hangs and hard
+        worker crashes are additionally reaped, but ONLY under a
+        configured ``trial_timeout``: a crashed worker's job simply
+        never completes (mp.Pool repopulates workers without completing
+        lost jobs), so without a deadline its ``get`` blocks forever —
+        same exposure as before this layer, and the reason --trial-
+        timeout is the recommended production setting."""
+        pool = self._get_pool()
+        t0 = time.monotonic()
+        asyncs = [pool.apply_async(_eval_one, (j,)) for j in jobs]
+        out: list[TrialResult] = []
+        broken = False
+        for i, (job, a) in enumerate(zip(jobs, asyncs)):
+            if self.trial_timeout is None:
+                wait = None
+            else:
+                # job i starts no later than wave i // n_workers, so its
+                # deadline is (wave+1) whole timeouts from batch start
+                # (plus dispatch grace): a job queued behind a hung
+                # worker still gets its own full window, while the whole
+                # batch is bounded by ~timeout * n_jobs / n_workers
+                allowance = self.trial_timeout * (i // self.n_workers + 1) + 1.0
+                wait = max(0.05, t0 + allowance - time.monotonic())
+            try:
+                out.append(a.get(wait))
+            except mp.TimeoutError:
+                broken = True
+                out.append(
+                    failed_result(
+                        job[0],
+                        job[2],
+                        f"no result within {self.trial_timeout}s "
+                        "(trial hung, or its worker crashed)",
+                        status="timeout",
+                        wall_time=time.monotonic() - t0,
+                    )
+                )
+            except Exception as e:
+                # pool-level failure (worker killed hard enough that the
+                # result machinery raised instead of hanging)
+                broken = True
+                out.append(
+                    failed_result(
+                        job[0],
+                        job[2],
+                        f"worker failure: {type(e).__name__}: {e}",
+                        wall_time=time.monotonic() - t0,
+                    )
+                )
+        if broken:
+            # a reaped job's worker is still wedged (or gone): recycle
+            # the whole pool so the next batch starts with clean workers
+            self._rebuild_pool()
+        return out
+
+    def _rebuild_pool(self):
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool.join()
+            self._pool = None
 
     def _inline_ok(self) -> bool:
         """Inline (in-parent) evaluation is only allowed when the parent
@@ -199,7 +313,27 @@ class CPUBackend(Backend):
             state = self.workload.init_state(params, self.seed)
             done = 0
         remaining = max(0, t.budget - done)
-        state, score = self.workload.train(state, params, remaining, self.seed)
+        try:
+            state, score = self.workload.train(state, params, remaining, self.seed)
+        except Exception as e:
+            # the failed member's state is NOT stored: a PBT successor
+            # inheriting from it would resume a half-trained wreck. No
+            # timeout is possible here (in-parent execution can't be
+            # interrupted) — that's the documented stateful-path limit.
+            return failed_result(
+                t.trial_id,
+                t.budget,
+                f"{type(e).__name__}: {e}",
+                wall_time=time.perf_counter() - t0,
+            )
+        if not math.isfinite(float(score)):
+            return failed_result(
+                t.trial_id,
+                t.budget,
+                f"non-finite score {float(score)!r}",
+                score=float(score),
+                wall_time=time.perf_counter() - t0,
+            )
         self._states[t.trial_id] = state
         self._states.move_to_end(t.trial_id)
         self._trained[t.trial_id] = t.budget

@@ -42,7 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpi_opt_tpu.backends.base import Backend, register_backend
-from mpi_opt_tpu.trial import Trial, TrialResult
+from mpi_opt_tpu.trial import Trial, TrialResult, failed_result
 from mpi_opt_tpu.workloads.base import Workload
 
 
@@ -274,13 +274,37 @@ class TPUPopulationBackend(Backend):
         wall = time.perf_counter() - t0
         out: dict[int, TrialResult] = {}
         for i, (t, _, _, _, _) in enumerate(entries):
-            self._trained[t.trial_id] = t.budget
-            out[t.trial_id] = TrialResult(
-                trial_id=t.trial_id,
-                score=float(scores[i]),
-                step=t.budget,
-                wall_time=wall / n,
-            )
+            s = float(scores[i])
+            if np.isfinite(s):
+                self._trained[t.trial_id] = t.budget
+                out[t.trial_id] = TrialResult(
+                    trial_id=t.trial_id,
+                    score=s,
+                    step=t.budget,
+                    wall_time=wall / n,
+                )
+            else:
+                # same per-trial failure contract as the CPU backend: a
+                # diverged member (NaN/inf eval) reports as failed, not
+                # as an "ok" result whose poison score every consumer
+                # must remember to isfinite-gate. The diverged state is
+                # EVICTED from the ledger (slot back on the free list),
+                # mirroring the CPU stateful path's store-nothing rule:
+                # a driver retry then resolves the trial as fresh and
+                # retrains from scratch instead of re-evaluating the
+                # wreck for zero steps, and a PBT successor can never
+                # inherit it
+                slot = self._slot_of.pop(t.trial_id, None)
+                if slot is not None:
+                    self._free.append(slot)
+                self._trained.pop(t.trial_id, None)
+                out[t.trial_id] = failed_result(
+                    t.trial_id,
+                    t.budget,
+                    f"non-finite score {s!r} (member diverged)",
+                    score=s,
+                    wall_time=wall / n,
+                )
         return out
 
     def close(self):

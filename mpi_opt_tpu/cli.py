@@ -187,6 +187,46 @@ def build_parser() -> argparse.ArgumentParser:
         "With --checkpoint-dir each retry resumes at the last snapshot; "
         "without, it restarts the (deterministic) sweep from scratch",
     )
+    # per-trial failure policy (driver path; SURVEY.md §5): --retries
+    # above recovers whole-SWEEP platform deaths, these recover
+    # individual trials — the normal HPO failure mode (extreme
+    # hyperparameters are part of the search space)
+    p.add_argument(
+        "--trial-retries",
+        type=int,
+        default=0,
+        help="driver path: re-evaluate a failed/timed-out trial up to "
+        "this many times (jittered exponential backoff between "
+        "attempts) before reporting it as failed",
+    )
+    p.add_argument(
+        "--trial-timeout",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="cpu backend: per-trial evaluation deadline; a trial still "
+        "running past it is reaped as a 'timeout' result and its worker "
+        "pool recycled (unset = wait forever)",
+    )
+    p.add_argument(
+        "--max-failure-rate",
+        type=float,
+        default=1.0,
+        metavar="FRAC",
+        help="driver path: abort the sweep once more than this fraction "
+        "of trial evaluations has failed (checked after 20 evaluations; "
+        "1.0 disables). Catches systemic bugs fast instead of grinding "
+        "through thousands of doomed trials",
+    )
+    p.add_argument(
+        "--chaos",
+        default=None,
+        metavar="SPEC",
+        help="fault-injection drill (driver path): wrap the workload in "
+        "seeded chaos, e.g. 'exc=0.1,nan=0.05,hang=0.02,slow=0.1,seed=7' "
+        "(probabilities per fault; hang_s=/slow_s= tune durations). "
+        "Faults are a deterministic function of (seed, trial params)",
+    )
     return p
 
 
@@ -522,6 +562,17 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.resume and not args.checkpoint_dir:
         parser.error("--resume requires --checkpoint-dir")
+    # validate the failure-policy flags HERE so a bad value is a usage
+    # error (exit 2), not a ValueError traceback from FailurePolicy or
+    # the backend constructor deep in the run
+    if args.trial_retries < 0:
+        parser.error(f"--trial-retries must be >= 0, got {args.trial_retries}")
+    if not 0.0 < args.max_failure_rate <= 1.0:
+        parser.error(
+            f"--max-failure-rate must be in (0, 1], got {args.max_failure_rate}"
+        )
+    if args.trial_timeout is not None and args.trial_timeout <= 0:
+        parser.error(f"--trial-timeout must be > 0, got {args.trial_timeout}")
     # platform pinning, then multi-host bring-up, BEFORE anything
     # touches the XLA backend (build_mesh, workload data, backend
     # construction all do) — both are only possible pre-initialization
@@ -538,7 +589,9 @@ def main(argv=None) -> int:
         try:
             jax.config.update("jax_platforms", args.platform)
             if args.local_devices is not None:
-                jax.config.update("jax_num_cpu_devices", args.local_devices)
+                from mpi_opt_tpu.utils.hostdev import request_cpu_devices
+
+                request_cpu_devices(args.local_devices)
         except RuntimeError as e:
             parser.error(
                 f"--platform/--local-devices must be set before any JAX "
@@ -574,6 +627,22 @@ def main(argv=None) -> int:
                 "JAX use in the process)"
             )
     workload = get_workload(args.workload)
+    chaos_kwargs = None
+    if args.chaos is not None:
+        if args.fused or args.backend != "cpu":
+            parser.error(
+                "--chaos exercises the host driver's trial-level failure "
+                "policy through the cpu backend; fused/TPU sweeps have no "
+                "per-trial injection point (their divergence masking is "
+                "always on)"
+            )
+        from mpi_opt_tpu.workloads.chaos import parse_chaos_spec
+
+        try:
+            chaos_kwargs = {"inner": args.workload, **parse_chaos_spec(args.chaos)}
+            workload = get_workload("chaos", **chaos_kwargs)
+        except ValueError as e:
+            parser.error(f"--chaos: {e}")
     if args.fused:
         return run_fused(args, parser, workload)
     space = workload.default_space()
@@ -581,7 +650,16 @@ def main(argv=None) -> int:
     mesh = None
     backend_kwargs = {}
     if args.backend == "cpu":
-        backend_kwargs = {"n_workers": args.workers, "seed": args.seed}
+        backend_kwargs = {
+            "n_workers": args.workers,
+            "seed": args.seed,
+            "trial_timeout": args.trial_timeout,
+        }
+        if chaos_kwargs is not None:
+            # pool workers rebuild the workload from (name, kwargs);
+            # without this they would reconstruct a default (fault-free)
+            # chaos wrapper and the drill would silently inject nothing
+            backend_kwargs["workload_kwargs"] = chaos_kwargs
     elif args.backend == "tpu":
         mesh = build_mesh(args)
         backend_kwargs = {"population": args.population, "seed": args.seed, "mesh": mesh}
@@ -605,13 +683,31 @@ def main(argv=None) -> int:
         if args.resume:
             step = checkpointer.restore_into(algorithm, backend)
             metrics.log("resume", step=step)
+    from mpi_opt_tpu.driver import FailurePolicy, SweepAborted
     from mpi_opt_tpu.utils.profiling import profile_window
 
+    policy = FailurePolicy(
+        max_retries=args.trial_retries,
+        max_failure_rate=args.max_failure_rate,
+        seed=args.seed,
+    )
     try:
         with profile_window(args.profile_dir):
             result = run_search(
-                algorithm, backend, metrics=metrics, checkpointer=checkpointer
+                algorithm,
+                backend,
+                metrics=metrics,
+                checkpointer=checkpointer,
+                policy=policy,
             )
+    except SweepAborted as e:
+        # the circuit breaker tripping is an OPERATOR outcome, not a
+        # crash: summarize the counters that tripped it and exit nonzero
+        # (launch.py supervisors see a retryable rc=1, not a usage error)
+        metrics.summary(**{"final": True, "aborted": True})
+        print(json.dumps({"aborted": str(e)}))
+        print(str(e), file=sys.stderr)
+        return 1
     finally:
         backend.close()
         if checkpointer is not None:
@@ -624,6 +720,9 @@ def main(argv=None) -> int:
         "n_trials": result.n_trials,
         "wall_s": round(result.wall_s, 3),
         "trials_per_sec_per_chip": round(result.trials_per_sec_per_chip, 4),
+        "trials_failed": metrics.trials_failed,
+        "trials_retried": metrics.trials_retried,
+        "trials_timeout": metrics.trials_timeout,
         "best_score": None if best is None else round(best.score, 6),
         "best_params": None
         if best is None

@@ -12,12 +12,13 @@ hold the loop between generations without extra driver modes.
 from __future__ import annotations
 
 import dataclasses
+import random
 import time
-from typing import Optional
+from typing import Optional, Sequence
 
 from mpi_opt_tpu.algorithms.base import Algorithm
 from mpi_opt_tpu.backends.base import Backend
-from mpi_opt_tpu.trial import Trial
+from mpi_opt_tpu.trial import Trial, TrialResult
 from mpi_opt_tpu.utils.metrics import MetricsLogger, null_logger
 
 
@@ -31,6 +32,131 @@ class SearchResult:
     # algorithms (each ASHA promotion re-enters the backend), and the
     # numerator of trials_per_sec_per_chip
     n_evals: int = 0
+    # final per-status failure tallies for this call (post-retry)
+    n_failed: int = 0
+    n_timeout: int = 0
+    n_retried: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class FailurePolicy:
+    """How the driver treats non-ok trial results.
+
+    Retries re-enter ``backend.evaluate`` for just the failed trials, up
+    to ``max_retries`` times per trial, sleeping a jittered exponential
+    backoff between rounds (attempt k waits ``backoff_s * 2**(k-1)``,
+    scaled by up to ``backoff_jitter`` of random extra — the jitter
+    keeps a fleet of retrying drivers from synchronizing against a
+    shared resource). Trials still failing after the retries are
+    reported to the algorithm as FINAL failures.
+
+    ``max_failure_rate`` is the systemic-bug circuit breaker: when the
+    fraction of final failures over all evaluations exceeds it (checked
+    only once ``min_evals_for_abort`` evaluations exist, so a tiny
+    denominator can't trip it), the sweep raises ``SweepAborted``
+    instead of grinding through thousands of doomed trials. 1.0
+    disables the breaker (some sweeps legitimately fail a lot).
+    """
+
+    max_retries: int = 0
+    backoff_s: float = 0.1
+    backoff_jitter: float = 0.5
+    max_failure_rate: float = 1.0
+    min_evals_for_abort: int = 20
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {self.max_retries}")
+        if not 0.0 < self.max_failure_rate <= 1.0:
+            raise ValueError(
+                f"max_failure_rate must be in (0, 1], got {self.max_failure_rate}"
+            )
+
+    def backoff(self, attempt: int, rng: random.Random) -> float:
+        """Seconds to sleep before retry ``attempt`` (1-based)."""
+        return self.backoff_s * (2 ** (attempt - 1)) * (1.0 + self.backoff_jitter * rng.random())
+
+
+class SweepAborted(RuntimeError):
+    """Raised when the failure fraction crosses FailurePolicy.max_failure_rate."""
+
+
+class _FailureTracker:
+    """Per-search retry/abort bookkeeping for one run_search call."""
+
+    def __init__(self, policy: FailurePolicy, metrics: MetricsLogger):
+        self.policy = policy
+        self.metrics = metrics
+        self.rng = random.Random(policy.seed)
+        self.evaluated = 0  # final results seen (ok + failed)
+        self.failed = 0  # final non-ok results
+        self.timeout = 0
+        self.retried = 0
+
+    def evaluate(self, backend: Backend, batch: Sequence[Trial]) -> list[TrialResult]:
+        """backend.evaluate with per-trial retries; returns FINAL results
+        aligned with ``batch`` order."""
+        results = backend.evaluate(batch)
+        final = {r.trial_id: r for r in results}
+        if self.policy.max_retries > 0:
+            by_id = {t.trial_id: t for t in batch}
+            for attempt in range(1, self.policy.max_retries + 1):
+                retry = [by_id[tid] for tid, r in final.items() if not r.ok]
+                if not retry:
+                    break
+                delay = self.policy.backoff(attempt, self.rng)
+                if delay > 0:
+                    time.sleep(delay)
+                self.retried += len(retry)
+                self.metrics.count_retries(len(retry))
+                self.metrics.log(
+                    "trial_retry",
+                    attempt=attempt,
+                    of=self.policy.max_retries,
+                    trials=[t.trial_id for t in retry],
+                    backoff_s=round(delay, 3),
+                )
+                for r in backend.evaluate(retry):
+                    final[r.trial_id] = r
+        out = [final[t.trial_id] for t in batch]
+        self._account(out)
+        return out
+
+    def _account(self, results: Sequence[TrialResult]) -> None:
+        self.evaluated += len(results)
+        # count the batch HERE, before the abort check can raise: an
+        # aborting batch's failures must not appear in the summary's
+        # failure counters with their evaluations missing from `trials`
+        # (operators compute failure fractions from that pair)
+        self.metrics.count_trials(len(results))
+        for r in results:
+            if r.ok:
+                continue
+            self.failed += 1
+            if r.status == "timeout":
+                self.timeout += 1
+            self.metrics.count_failure(r.status)
+            self.metrics.log(
+                "trial_failed",
+                trial_id=r.trial_id,
+                status=r.status,
+                error=r.error,
+                step=r.step,
+            )
+        if (
+            self.policy.max_failure_rate < 1.0
+            and self.evaluated >= self.policy.min_evals_for_abort
+            and self.failed / self.evaluated > self.policy.max_failure_rate
+        ):
+            msg = (
+                f"sweep aborted: {self.failed}/{self.evaluated} trial "
+                f"evaluations failed ({self.failed / self.evaluated:.0%} > "
+                f"max_failure_rate {self.policy.max_failure_rate:.0%}) — "
+                "a systemic failure, not unlucky hyperparameters"
+            )
+            self.metrics.log("sweep_aborted", error=msg)
+            raise SweepAborted(msg)
 
 
 def run_search(
@@ -39,6 +165,7 @@ def run_search(
     metrics: Optional[MetricsLogger] = None,
     max_batches: Optional[int] = None,
     checkpointer=None,
+    policy: Optional[FailurePolicy] = None,
 ) -> SearchResult:
     """Drive the suggest→evaluate→report loop to completion.
 
@@ -46,8 +173,16 @@ def run_search(
     algorithm + backend state after report_batch on its cadence, so a
     killed process resumes at the last completed batch instead of
     restarting the sweep.
+
+    ``policy`` (FailurePolicy) governs non-ok trial results: retries
+    with jittered backoff first, then the FINAL result — ok or failed —
+    is reported to the algorithm, and the failure-rate circuit breaker
+    raises ``SweepAborted`` on systemic failure. The default policy is
+    no retries and no breaker, so failed trials flow straight through
+    as FAILED reports.
     """
     metrics = metrics or null_logger()
+    tracker = _FailureTracker(policy or FailurePolicy(), metrics)
     t0 = time.perf_counter()
     batches = 0
     n_run = 0  # trials evaluated by THIS run (metrics may be shared/reused)
@@ -60,9 +195,10 @@ def run_search(
                 f"{algorithm.name}: no trials to run but search not finished "
                 "(algorithm is waiting on results that were never reported)"
             )
-        results = backend.evaluate(batch)
+        # tracker.evaluate owns metrics.count_trials for the batch (it
+        # must tally even a batch whose abort check raises)
+        results = tracker.evaluate(backend, batch)
         algorithm.report_batch(results)
-        metrics.count_trials(len(results))
         n_run += len(results)
         best = algorithm.best()
         metrics.log(
@@ -84,4 +220,7 @@ def run_search(
         wall_s=wall,
         trials_per_sec_per_chip=n_run / max(wall, 1e-9) / metrics.n_chips,
         n_evals=n_run,
+        n_failed=tracker.failed - tracker.timeout,
+        n_timeout=tracker.timeout,
+        n_retried=tracker.retried,
     )

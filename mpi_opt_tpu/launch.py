@@ -36,11 +36,24 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import socket
 import subprocess
 import sys
 import tempfile
 import time
+
+
+def _backoff_s(attempt: int, base: float, jitter: float, rng: random.Random) -> float:
+    """Seconds to wait before coordinated restart ``attempt`` (1-based):
+    jittered exponential, ``base * 2**(attempt-1)`` scaled by up to
+    ``jitter`` extra. An immediate relaunch hammers a flapping platform
+    (a TPU worker mid-restart rejects the reconnect, burning a retry for
+    nothing), and the jitter keeps N supervisors that died together from
+    reconnecting in lockstep."""
+    if base <= 0:
+        return 0.0
+    return base * (2 ** (attempt - 1)) * (1.0 + jitter * rng.random())
 
 
 def _free_port() -> int:
@@ -124,6 +137,14 @@ def main(argv=None) -> int:
         "--poll-interval", type=float, default=0.2, help="rank liveness poll (s)"
     )
     parser.add_argument(
+        "--restart-backoff",
+        type=float,
+        default=1.0,
+        metavar="SECONDS",
+        help="base delay before a coordinated restart; doubles per "
+        "attempt with up to 50%% random jitter (0 disables)",
+    )
+    parser.add_argument(
         "rest",
         nargs=argparse.REMAINDER,
         help="-- followed by the mpi_opt_tpu CLI arguments for every rank",
@@ -152,6 +173,7 @@ def main(argv=None) -> int:
     os.makedirs(log_dir, exist_ok=True)
 
     has_ckpt = _has_flag(rest, "--checkpoint-dir")
+    backoff_rng = random.Random(os.getpid())
     attempt = 0
     while True:
         rank_args = list(rest)
@@ -223,6 +245,7 @@ def main(argv=None) -> int:
             )
             return 1
         attempt += 1
+        delay = _backoff_s(attempt, args.restart_backoff, 0.5, backoff_rng)
         print(
             json.dumps(
                 {
@@ -231,10 +254,13 @@ def main(argv=None) -> int:
                     "returncode": rc,
                     "attempt": attempt,
                     "of": args.retries,
+                    "backoff_s": round(delay, 3),
                 }
             ),
             flush=True,
         )
+        if delay > 0:
+            time.sleep(delay)
 
 
 if __name__ == "__main__":

@@ -90,8 +90,22 @@ class SearchCheckpointer:
         try:
             meta = self._mgr.item_metadata(step)
             return set(meta.keys()) if hasattr(meta, "keys") else set()
-        except Exception:
-            # metadata probe is best-effort; fall back to directory list
+        except Exception as e:
+            # the metadata probe is best-effort, but a silent blanket
+            # swallow would hide an orbax API break indefinitely:
+            # surface what failed (type + step) before falling back, so
+            # a probe that is ALWAYS failing is visible instead of
+            # quietly degrading every restore to the weaker directory
+            # heuristic
+            import warnings
+
+            warnings.warn(
+                f"checkpoint metadata probe failed at step {step} "
+                f"({type(e).__name__}: {e}); falling back to directory "
+                "listing to detect snapshot items",
+                RuntimeWarning,
+                stacklevel=2,
+            )
             step_dir = os.path.join(self.directory, str(step))
             return set(os.listdir(step_dir)) if os.path.isdir(step_dir) else set()
 

@@ -45,3 +45,50 @@ def host_ops():
     if _CPU is None:
         return contextlib.nullcontext()
     return jax.default_device(_CPU)
+
+
+def request_cpu_devices(n: int) -> None:
+    """Ask for ``n`` virtual CPU devices — must run BEFORE the first
+    backend initialization (the same pre-init contract as platform
+    pinning).
+
+    Newer jax exposes this as the ``jax_num_cpu_devices`` config; pre-0.5
+    jax (this container ships 0.4.x) only honors the XLA flag, which is
+    read at backend init. Any device-count flag already present in
+    XLA_FLAGS is REPLACED, not appended to: SPMD test workers inherit
+    the parent pytest process's 8-device flag and must be able to
+    override it with their own count.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+        return
+    except AttributeError:
+        pass
+    # the config knob raises RuntimeError when the backend is already
+    # up; the env-var route would just be silently ignored — keep the
+    # loud post-init failure on both paths
+    try:
+        from jax._src import xla_bridge
+
+        initialized = xla_bridge.backends_are_initialized()
+    except Exception:
+        initialized = False  # private-API probe: fall through quietly
+    if initialized:
+        raise RuntimeError(
+            f"request_cpu_devices({n}) after the JAX backend initialized: "
+            "XLA_FLAGS is only read at backend init, so the request would "
+            "be silently ignored"
+        )
+    import os
+    import re
+
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    os.environ["XLA_FLAGS"] = (
+        flags.strip() + f" --xla_force_host_platform_device_count={n}"
+    ).strip()

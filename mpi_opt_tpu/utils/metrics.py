@@ -19,19 +19,37 @@ class MetricsLogger:
         self.n_chips = max(1, n_chips)
         self.t_start = time.perf_counter()
         self.trials_done = 0
+        # failure-lifecycle counters (driver.FailurePolicy feeds these):
+        # trials_failed/trials_timeout count FINAL non-ok results (after
+        # retries, disjoint by status); trials_retried counts retry
+        # ATTEMPTS, so retried-then-recovered trials stay visible
+        self.trials_failed = 0
+        self.trials_timeout = 0
+        self.trials_retried = 0
 
     def log(self, event: str, **fields) -> dict:
         rec = {"event": event, "t": round(time.perf_counter() - self.t_start, 4), **fields}
-        line = json.dumps(rec)
-        if self._file:
-            self._file.write(line + "\n")
-            self._file.flush()
-        if self._stream:
-            print(line, file=self._stream, flush=True)
+        if self._file or self._stream:  # null_logger: no sink, no json cost
+            line = json.dumps(rec)
+            if self._file:
+                self._file.write(line + "\n")
+                self._file.flush()
+            if self._stream:
+                print(line, file=self._stream, flush=True)
         return rec
 
     def count_trials(self, n: int):
         self.trials_done += n
+
+    def count_failure(self, status: str = "failed"):
+        """One FINAL non-ok trial result (post-retry)."""
+        if status == "timeout":
+            self.trials_timeout += 1
+        else:
+            self.trials_failed += 1
+
+    def count_retries(self, n: int = 1):
+        self.trials_retried += n
 
     @property
     def wall(self) -> float:
@@ -44,6 +62,9 @@ class MetricsLogger:
         return self.log(
             "summary",
             trials=self.trials_done,
+            trials_failed=self.trials_failed,
+            trials_retried=self.trials_retried,
+            trials_timeout=self.trials_timeout,
             wall_s=round(self.wall, 3),
             trials_per_sec_per_chip=round(self.trials_per_sec_per_chip(), 4),
             **extra,

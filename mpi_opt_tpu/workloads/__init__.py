@@ -34,7 +34,8 @@ def available() -> list[str]:
     return sorted(_REGISTRY)
 
 
-# import for registration side effects
+# import for registration side effects (chaos last: it wraps the others)
 from mpi_opt_tpu.workloads import digits, synthetic, tabular, vision  # noqa: E402,F401
+from mpi_opt_tpu.workloads import chaos  # noqa: E402,F401
 
 __all__ = ["Workload", "register", "get_workload", "available"]

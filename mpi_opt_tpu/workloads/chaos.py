@@ -1,0 +1,191 @@
+"""Seeded fault-injection harness: wrap any workload in configured chaos.
+
+The trial-level fault-tolerance layer (TrialResult.status, the CPU
+backend's per-job reaping, driver.FailurePolicy) is only trustworthy if
+it can be EXERCISED on demand — HPO's whole premise is that some trials
+fail (extreme hyperparameters are part of the search space), but
+organic failures are rare and unseeded. ``ChaosWorkload`` injects the
+production failure shapes at configured probabilities:
+
+- ``exc``:  the evaluation raises (bad hyperparameter -> OOM, sklearn
+  convergence error, assertion in user code)
+- ``nan``:  training "succeeds" but the score is NaN (diverged loss)
+- ``hang``: the evaluation blocks (deadlocked worker, wedged I/O) —
+  reaped by the CPU backend's per-trial timeout
+- ``crash``: the WORKER PROCESS dies hard (os._exit: segfault/OOM-kill
+  stand-in) — its queued result never arrives, so this too is reaped
+  by the per-trial timeout, and the backend recycles the pool
+- ``slow``: the evaluation takes extra wall time (straggler rank)
+
+Determinism contract: whether a trial is faulted is a pure function of
+``(chaos_seed, params)`` via a SHA-256 draw — stable across processes
+(pool workers reconstruct the wrapper by registry name), across runs,
+and independent of scheduling. A faulted trial is therefore faulted on
+every retry too: chaos models DETERMINISTIC failures (the
+hyperparameters themselves are poison). Clean trials score exactly what
+the inner workload scores, so a chaos sweep's best trial matches the
+clean sweep's best whenever the clean winner isn't in the faulted
+fraction — the property the determinism test pins.
+
+Registry shape: ``get_workload("chaos", inner="quadratic", exc=0.2)``.
+The CPU backend's pool workers rebuild workloads from
+``(name, workload_kwargs)``, so the CLI passes the same kwargs dict to
+both the wrapper construction and the backend (see cli.main).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+from mpi_opt_tpu.space import SearchSpace
+from mpi_opt_tpu.workloads import get_workload, register
+from mpi_opt_tpu.workloads.base import Workload
+
+
+class ChaosInjectedError(RuntimeError):
+    """The exception ``exc`` faults raise — distinct so tests and log
+    readers can tell injected failures from organic ones."""
+
+
+def parse_chaos_spec(spec: str) -> dict:
+    """``"exc=0.1,nan=0.05,hang=0.02,slow=0.1,seed=7"`` -> kwargs for
+    ChaosWorkload. Unknown keys are rejected loudly (a typoed fault name
+    silently injecting nothing would fake a green chaos drill)."""
+    out: dict = {}
+    numeric = {
+        "exc": float, "nan": float, "hang": float, "crash": float,
+        "slow": float, "hang_s": float, "slow_s": float, "seed": int,
+    }
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"chaos spec entry {part!r} is not key=value "
+                f"(known keys: {sorted(numeric)})"
+            )
+        k, v = part.split("=", 1)
+        k = k.strip().replace("-", "_")
+        if k not in numeric:
+            raise ValueError(
+                f"unknown chaos key {k!r} (known: {sorted(numeric)})"
+            )
+        out[k] = numeric[k](v)
+    for p in ("exc", "nan", "hang", "crash", "slow"):
+        if not 0.0 <= out.get(p, 0.0) <= 1.0:
+            raise ValueError(f"chaos probability {p}={out[p]} outside [0, 1]")
+    return out
+
+
+@register
+class ChaosWorkload(Workload):
+    name = "chaos"
+
+    def __init__(
+        self,
+        inner: str = "quadratic",
+        exc: float = 0.0,
+        nan: float = 0.0,
+        hang: float = 0.0,
+        crash: float = 0.0,
+        slow: float = 0.0,
+        hang_s: float = 600.0,
+        slow_s: float = 0.25,
+        seed: int = 0,
+        inner_kwargs: dict | None = None,
+    ):
+        total = exc + nan + hang + crash + slow
+        if total > 1.0:
+            raise ValueError(
+                f"chaos probabilities sum to {total} > 1 "
+                "(exc+nan+hang+crash+slow)"
+            )
+        self.inner = get_workload(inner, **(inner_kwargs or {}))
+        self.p_exc = exc
+        self.p_nan = nan
+        self.p_hang = hang
+        self.p_crash = crash
+        self.p_slow = slow
+        self.hang_s = hang_s
+        self.slow_s = slow_s
+        self.chaos_seed = seed
+
+    def default_space(self) -> SearchSpace:
+        return self.inner.default_space()
+
+    # -- the seeded draw ---------------------------------------------------
+
+    def fault_for(self, params: dict) -> str | None:
+        """Which fault (if any) this trial draws: a pure function of
+        (chaos_seed, cleaned params). SHA-256, not hash(): stable across
+        processes regardless of PYTHONHASHSEED."""
+        payload = json.dumps(
+            [
+                self.chaos_seed,
+                sorted(
+                    (k, repr(v))
+                    for k, v in params.items()
+                    if not k.startswith("__")
+                ),
+            ]
+        )
+        h = hashlib.sha256(payload.encode()).digest()
+        u = int.from_bytes(h[:8], "big") / 2**64  # uniform [0, 1)
+        edge = 0.0
+        for fault, p in (
+            ("exc", self.p_exc),
+            ("nan", self.p_nan),
+            ("hang", self.p_hang),
+            ("crash", self.p_crash),
+            ("slow", self.p_slow),
+        ):
+            edge += p
+            if u < edge:
+                return fault
+        return None
+
+    def _apply(self, fault: str | None, params: dict) -> None:
+        """Pre-evaluation faults (exceptions and stalls)."""
+        if fault == "exc":
+            raise ChaosInjectedError(
+                f"chaos: injected trial failure (seed={self.chaos_seed})"
+            )
+        if fault == "hang":
+            time.sleep(self.hang_s)
+        elif fault == "crash":
+            # the hard-death stand-in: no exception to catch, no result
+            # queued — exactly what a segfaulted/OOM-killed worker looks
+            # like to the parent
+            os._exit(13)
+        elif fault == "slow":
+            time.sleep(self.slow_s)
+
+    # -- stateless protocol ------------------------------------------------
+
+    def evaluate(self, params: dict, budget: int, seed: int) -> float:
+        fault = self.fault_for(params)
+        self._apply(fault, params)
+        score = self.inner.evaluate(params, budget, seed)
+        return float("nan") if fault == "nan" else score
+
+    # -- stateful protocol (delegated; faults fire in train) ---------------
+
+    @property
+    def stateful(self) -> bool:
+        # NOT the base class's "did the subclass override train" probe:
+        # this wrapper always defines train, but it is only genuinely
+        # stateful when the inner workload is
+        return self.inner.stateful
+
+    def init_state(self, params: dict, seed: int):
+        return self.inner.init_state(params, seed)
+
+    def train(self, state, params: dict, steps: int, seed: int):
+        fault = self.fault_for(params)
+        self._apply(fault, params)
+        state, score = self.inner.train(state, params, steps, seed)
+        return state, (float("nan") if fault == "nan" else score)

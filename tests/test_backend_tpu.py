@@ -192,3 +192,56 @@ def test_meshed_slot_pool_shards_and_matches_unmeshed(workload):
     for m, p in zip(r_mesh, r_plain):
         assert m.trial_id == p.trial_id
         assert m.score == pytest.approx(p.score, abs=0.02)
+
+
+def test_nonfinite_score_reports_failed_result(workload, monkeypatch):
+    """A diverged member (NaN/inf eval score) comes back as a FAILED
+    result — the driver-path contract matching the CPU backend — not as
+    an 'ok' result whose poison score every consumer must gate. The
+    divergence is injected at the eval boundary (real divergence needs
+    an exploding LR and many steps; the contract is what's under test)."""
+    be = get_backend("tpu", workload, population=4, seed=5)
+    space = workload.default_space()
+    trials = [_trial(space, 50 + i, budget=5, seed=5) for i in range(3)]
+    be._setup()
+    real = be._trainer.eval_population
+
+    def poisoned(*a, **k):
+        scores = np.asarray(real(*a, **k)).copy()
+        scores[0] = np.nan
+        return scores
+
+    monkeypatch.setattr(be._trainer, "eval_population", poisoned)
+    results = be.evaluate(trials)
+    assert results[0].status == "failed"
+    assert np.isnan(results[0].score)
+    assert "diverged" in results[0].error
+    assert all(r.ok and 0.0 <= r.score <= 1.0 for r in results[1:])
+
+
+def test_failed_trial_evicted_so_retry_retrains(workload, monkeypatch):
+    """A failed (diverged) trial must leave the ledger: a driver retry
+    resolves it as FRESH and retrains from scratch, instead of warm-
+    resuming the diverged state for 0 remaining steps and failing
+    identically on every attempt."""
+    be = get_backend("tpu", workload, population=4, seed=6)
+    space = workload.default_space()
+    t = _trial(space, 60, budget=5, seed=6)
+    be._setup()
+    real = be._trainer.eval_population
+    calls = {"n": 0}
+
+    def poison_first(*a, **k):
+        calls["n"] += 1
+        scores = np.asarray(real(*a, **k)).copy()
+        if calls["n"] == 1:
+            scores[0] = np.nan
+        return scores
+
+    monkeypatch.setattr(be._trainer, "eval_population", poison_first)
+    (r1,) = be.evaluate([t])
+    assert r1.status == "failed"
+    assert 60 not in be._trained and 60 not in be._slot_of  # evicted
+    (r2,) = be.evaluate([t])  # the driver's retry
+    assert r2.ok and 0.0 <= r2.score <= 1.0
+    assert be._trained[60] == 5  # genuinely retrained to budget

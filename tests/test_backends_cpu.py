@@ -72,3 +72,68 @@ def test_stateful_inheritance_copies_source_state():
     # inherited w is already ~0 (lr=1 converges in one step), so even with
     # tiny lr the child's score reflects the inherited optimum
     assert r1.score > -0.05
+
+
+def test_inline_exception_becomes_failed_result():
+    """The inline (in-parent) stateless path catches per-trial
+    exceptions into failed results, same contract as the pool path."""
+    from mpi_opt_tpu.workloads import get_workload as gw
+
+    wl = gw("chaos", inner="digits", exc=1.0)
+    space = wl.default_space()
+    b = CPUBackend(wl, n_workers=1)
+    t = _trial(0, {"C": 1.0, "tol": 1e-4, "fit_intercept": True}, 10, space)
+    (r,) = b.evaluate([t])
+    b.close()
+    assert r.status == "failed"
+    assert "ChaosInjectedError" in r.error
+    assert np.isnan(r.score)
+
+
+def test_stateful_exception_becomes_failed_and_stores_no_state():
+    """The stateful path reports a raising trial as failed WITHOUT
+    storing its state: a PBT successor inheriting from it must retrain
+    fresh, not resume a half-trained wreck."""
+    from mpi_opt_tpu.workloads import get_workload as gw
+
+    wl = gw("chaos", inner="quadratic", exc=1.0)
+    space = wl.default_space()
+    b = CPUBackend(wl, n_workers=1)
+    t = _trial(7, {"lr": 0.5, "reg": 0.3}, 10, space)
+    (r,) = b.evaluate([t])
+    b.close()
+    assert r.status == "failed" and not r.ok
+    assert 7 not in b._states
+
+
+def test_stateful_nan_score_becomes_failed():
+    from mpi_opt_tpu.workloads import get_workload as gw
+
+    wl = gw("chaos", inner="quadratic", nan=1.0)
+    space = wl.default_space()
+    b = CPUBackend(wl, n_workers=1)
+    t = _trial(0, {"lr": 0.5, "reg": 0.3}, 10, space)
+    (r,) = b.evaluate([t])
+    b.close()
+    assert r.status == "failed"
+    assert "non-finite" in r.error
+    assert np.isnan(r.score)
+
+
+def test_trial_timeout_validation():
+    wl = get_workload("quadratic")
+    with pytest.raises(ValueError, match="trial_timeout"):
+        CPUBackend(wl, n_workers=1, trial_timeout=0.0)
+
+
+def test_stateful_trial_timeout_warns_unenforceable():
+    """--trial-timeout cannot interrupt in-parent stateful evaluation;
+    the backend must say so rather than silently ignore the deadline."""
+    wl = get_workload("quadratic")
+    space = wl.default_space()
+    b = CPUBackend(wl, n_workers=1, trial_timeout=5.0)
+    t = _trial(0, {"lr": 0.5, "reg": 0.3}, 10, space)
+    with pytest.warns(UserWarning, match="cannot be enforced for stateful"):
+        (r,) = b.evaluate([t])
+    b.close()
+    assert r.ok

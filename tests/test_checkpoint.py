@@ -123,3 +123,29 @@ def test_cli_checkpoint_resume_flow(tmp_path):
     ck = SearchCheckpointer(ckpt)
     assert ck.latest_step() is not None
     ck.close()
+
+
+def test_metadata_probe_failure_warns_before_fallback(tmp_path, quad):
+    """The item-metadata probe is best-effort, but its blanket except
+    must not be SILENT: a probe that always fails (an orbax API break)
+    should be visible as a warning naming the exception and step, while
+    the directory-listing fallback still resolves the snapshot items."""
+    space = quad.default_space()
+    algo = RandomSearch(space, seed=13, max_trials=4, budget=2)
+    b = CPUBackend(quad, n_workers=1)
+    with SearchCheckpointer(str(tmp_path / "ck"), every=1) as ck:
+        run_search(algo, b, max_batches=1, checkpointer=ck)
+        # drain the async save: the directory-listing fallback can only
+        # see a step whose write has committed
+        ck._mgr.wait_until_finished()
+        step = ck.latest_step()
+        assert step is not None
+
+        def broken_probe(_step):
+            raise RuntimeError("orbax item_metadata API drifted")
+
+        ck._mgr.item_metadata = broken_probe
+        with pytest.warns(RuntimeWarning, match=r"metadata probe failed at step 1.*RuntimeError"):
+            names = ck._item_names(step)
+        assert "search" in names  # the fallback still found the items
+    b.close()

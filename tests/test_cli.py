@@ -462,3 +462,114 @@ def _summary_from(out):
         if "best_score" in d:
             return d
     raise AssertionError(out)
+
+
+@pytest.mark.chaos
+def test_cli_chaos_drill_counts_failures_and_matches_clean_best(capsys):
+    """--chaos end-to-end: the sweep completes, the summary carries the
+    injected-failure counters, and the best trial matches the clean
+    run's (constants shared with tests/test_chaos.py)."""
+    base = [
+        "--workload", "quadratic",
+        "--algorithm", "random",
+        "--trials", "30",
+        "--budget", "20",
+        "--workers", "2",
+        "--seed", "0",
+    ]
+    assert main(base) == 0
+    clean = _summary(capsys)
+    assert clean["trials_failed"] == 0
+
+    assert main(base + ["--chaos", "exc=0.12,nan=0.08,seed=10"]) == 0
+    out = capsys.readouterr().out
+    drill = _summary_from(out)
+    assert drill["trials_failed"] == 9  # 5 exc + 4 nan, deterministic
+    assert drill["trials_retried"] == 0 and drill["trials_timeout"] == 0
+    assert drill["best_score"] == pytest.approx(clean["best_score"], abs=1e-9)
+    assert drill["best_params"] == clean["best_params"]
+    # per-trial failures are visible as metrics events, not just tallies
+    assert '"event": "trial_failed"' in out
+    # the summary EVENT carries the counters too (operators tail metrics)
+    summary_events = [
+        json.loads(l) for l in out.splitlines()
+        if l.startswith("{") and '"event": "summary"' in l
+    ]
+    assert summary_events and summary_events[-1]["trials_failed"] == 9
+
+
+@pytest.mark.chaos
+def test_cli_trial_retries_reach_the_driver(capsys):
+    """--trial-retries N: retry attempts show up in the summary counters
+    (chaos faults are deterministic, so every retry re-fails — the knob
+    exists for nondeterministic production failures)."""
+    rc = main([
+        "--workload", "quadratic", "--algorithm", "random",
+        "--trials", "30", "--budget", "20", "--workers", "2", "--seed", "0",
+        "--chaos", "exc=0.12,nan=0.08,seed=10",
+        "--trial-retries", "1",
+    ])
+    assert rc == 0
+    s = _summary(capsys)
+    assert s["trials_failed"] == 9
+    assert s["trials_retried"] == 9
+
+
+@pytest.mark.chaos
+def test_cli_max_failure_rate_aborts_systemic_failure(capsys):
+    """A sweep whose failure fraction crosses --max-failure-rate exits
+    nonzero with an 'aborted' line instead of grinding to the end."""
+    rc = main([
+        "--workload", "quadratic", "--algorithm", "random",
+        "--trials", "60", "--budget", "20", "--workers", "1", "--seed", "0",
+        "--chaos", "exc=0.9,seed=0",
+        "--max-failure-rate", "0.5",
+    ])
+    assert rc == 1
+    captured = capsys.readouterr()
+    lines = [l for l in captured.out.strip().splitlines() if l.startswith("{")]
+    aborted = json.loads(lines[-1])
+    assert "aborted" in aborted and "max_failure_rate" in aborted["aborted"]
+    assert "systemic" in captured.err
+
+
+def test_cli_chaos_rejects_fused():
+    with pytest.raises(SystemExit):
+        main([
+            "--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+            "--population", "4", "--generations", "1",
+            "--chaos", "exc=0.5",
+        ])
+
+
+def test_cli_chaos_rejects_bad_spec(capsys):
+    with pytest.raises(SystemExit):
+        main([
+            "--workload", "quadratic", "--trials", "2",
+            "--chaos", "explode=0.5",
+        ])
+    assert "unknown chaos key" in capsys.readouterr().err
+
+
+def test_cli_chaos_rejects_tpu_backend(capsys):
+    with pytest.raises(SystemExit):
+        main([
+            "--workload", "fashion_mlp", "--backend", "tpu",
+            "--trials", "2", "--chaos", "exc=0.5",
+        ])
+    assert "cpu backend" in capsys.readouterr().err
+
+
+def test_cli_validates_failure_policy_flags(capsys):
+    """Bad policy values are usage errors (exit 2 + message), not raw
+    ValueError tracebacks from deep inside the run."""
+    for argv, msg in (
+        (["--trial-retries", "-1"], "--trial-retries must be >= 0"),
+        (["--max-failure-rate", "0"], "--max-failure-rate must be in (0, 1]"),
+        (["--max-failure-rate", "1.5"], "--max-failure-rate must be in (0, 1]"),
+        (["--trial-timeout", "0"], "--trial-timeout must be > 0"),
+    ):
+        with pytest.raises(SystemExit) as exc:
+            main(["--workload", "quadratic", "--trials", "2", *argv])
+        assert exc.value.code == 2
+        assert msg in capsys.readouterr().err

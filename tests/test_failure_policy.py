@@ -1,0 +1,344 @@
+"""Trial-level failure lifecycle: FailurePolicy retries/abort in the
+driver, and every algorithm's handling of FAILED reports.
+
+The backend here is a scripted stub (no processes, no jax training):
+these tests pin the DRIVER and ALGORITHM contracts — what happens after
+a backend reports a non-ok TrialResult — independently of how the
+failure was produced. tests/test_chaos.py exercises the same contracts
+end-to-end through the real CPU backend + fault injection.
+"""
+
+import math
+import random
+
+import numpy as np
+import pytest
+
+from mpi_opt_tpu.algorithms import ASHA, BOHB, PBT, RandomSearch, TPE
+from mpi_opt_tpu.algorithms.hyperband import Hyperband
+from mpi_opt_tpu.backends.base import Backend
+from mpi_opt_tpu.driver import FailurePolicy, SweepAborted, run_search
+from mpi_opt_tpu.trial import TrialResult, TrialStatus, failed_result
+from mpi_opt_tpu.utils.metrics import null_logger
+from mpi_opt_tpu.workloads import get_workload
+
+
+class ScriptedBackend(Backend):
+    """Scores are a pure function of the trial's unit row; failures are
+    scripted per trial_id: ``fail[trial_id] = n`` fails the first n
+    attempts ('always' fails every attempt; status picks the flavor)."""
+
+    name = "scripted"
+
+    def __init__(self, workload, capacity=4, fail=None, status="failed"):
+        super().__init__(workload)
+        self._capacity = capacity
+        self.fail = fail or {}
+        self.status = status
+        self.attempts = {}  # trial_id -> evaluation count
+
+    @property
+    def capacity(self):
+        return self._capacity
+
+    def _score(self, t):
+        # deterministic, higher for units near 0.6 — arbitrary but stable
+        return -float(np.sum((np.asarray(t.unit) - 0.6) ** 2))
+
+    def evaluate(self, trials):
+        out = []
+        for t in trials:
+            n = self.attempts[t.trial_id] = self.attempts.get(t.trial_id, 0) + 1
+            budget = self.fail.get(t.trial_id, 0)
+            if budget == "always" or n <= budget:
+                out.append(
+                    failed_result(t.trial_id, t.budget, "scripted", status=self.status)
+                )
+            else:
+                out.append(TrialResult(t.trial_id, self._score(t), t.budget))
+        return out
+
+
+@pytest.fixture(scope="module")
+def space():
+    return get_workload("quadratic").default_space()
+
+
+# -- TrialResult contract --------------------------------------------------
+
+
+def test_trial_result_defaults_ok():
+    r = TrialResult(0, 0.5, 10)
+    assert r.ok and r.status == "ok" and r.error is None
+
+
+def test_failed_result_never_carries_finite_score():
+    r = failed_result(1, 10, "boom")
+    assert not r.ok and math.isnan(r.score)
+    # a finite score passed by mistake is forced to NaN
+    r2 = failed_result(1, 10, "boom", score=0.7)
+    assert math.isnan(r2.score)
+    # a diverged value is kept as the flag
+    r3 = failed_result(1, 10, "diverged", score=float("-inf"))
+    assert r3.score == float("-inf")
+    with pytest.raises(ValueError, match="failed|timeout"):
+        failed_result(1, 10, "boom", status="ok")
+
+
+# -- driver retry policy ---------------------------------------------------
+
+
+def test_retry_recovers_transient_failure(space):
+    wl = get_workload("quadratic")
+    algo = RandomSearch(space, seed=0, max_trials=8, budget=5)
+    # trial 2 fails twice then succeeds; trial 5 fails once
+    b = ScriptedBackend(wl, capacity=4, fail={2: 2, 5: 1})
+    m = null_logger()
+    res = run_search(
+        algo, b, metrics=m, policy=FailurePolicy(max_retries=2, backoff_s=0.0)
+    )
+    assert algo.finished()
+    # every trial ended up with a real score — the failures were transient
+    assert all(t.status == TrialStatus.DONE for t in algo.trials.values())
+    assert res.n_failed == 0 and res.n_retried == 3
+    assert m.trials_retried == 3 and m.trials_failed == 0
+    assert b.attempts[2] == 3 and b.attempts[5] == 2
+
+
+def test_retries_exhausted_reports_failed(space):
+    wl = get_workload("quadratic")
+    algo = RandomSearch(space, seed=0, max_trials=6, budget=5)
+    b = ScriptedBackend(wl, capacity=3, fail={1: "always"})
+    m = null_logger()
+    res = run_search(
+        algo, b, metrics=m, policy=FailurePolicy(max_retries=2, backoff_s=0.0)
+    )
+    assert algo.finished()
+    assert algo.trials[1].status == TrialStatus.FAILED
+    assert algo.trials[1].error == "scripted"
+    assert b.attempts[1] == 3  # 1 original + 2 retries
+    assert res.n_failed == 1 and res.n_retried == 2
+    assert m.trials_failed == 1
+    assert algo.best() is not None and algo.best().trial_id != 1
+
+
+def test_timeout_status_counted_separately(space):
+    wl = get_workload("quadratic")
+    algo = RandomSearch(space, seed=0, max_trials=4, budget=5)
+    b = ScriptedBackend(wl, capacity=4, fail={0: "always"}, status="timeout")
+    m = null_logger()
+    res = run_search(algo, b, metrics=m)
+    assert res.n_timeout == 1 and res.n_failed == 0
+    assert m.trials_timeout == 1 and m.trials_failed == 0
+
+
+def test_backoff_schedule_is_jittered_exponential():
+    p = FailurePolicy(max_retries=3, backoff_s=2.0, backoff_jitter=0.5)
+    rng = random.Random(0)
+    for attempt, base in ((1, 2.0), (2, 4.0), (3, 8.0)):
+        for _ in range(20):
+            d = p.backoff(attempt, rng)
+            assert base <= d <= base * 1.5
+    # jitter 0 -> exact doubling
+    p0 = FailurePolicy(backoff_s=1.0, backoff_jitter=0.0)
+    assert [p0.backoff(a, rng) for a in (1, 2, 3)] == [1.0, 2.0, 4.0]
+
+
+def test_driver_sleeps_backoff_between_retries(space, monkeypatch):
+    sleeps = []
+    import mpi_opt_tpu.driver as drv
+
+    monkeypatch.setattr(drv.time, "sleep", lambda s: sleeps.append(s))
+    wl = get_workload("quadratic")
+    algo = RandomSearch(space, seed=0, max_trials=2, budget=5)
+    b = ScriptedBackend(wl, capacity=2, fail={0: 2})
+    run_search(algo, b, policy=FailurePolicy(max_retries=2, backoff_s=1.0))
+    assert len(sleeps) == 2
+    assert 1.0 <= sleeps[0] <= 1.5 and 2.0 <= sleeps[1] <= 3.0
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="max_retries"):
+        FailurePolicy(max_retries=-1)
+    with pytest.raises(ValueError, match="max_failure_rate"):
+        FailurePolicy(max_failure_rate=0.0)
+    with pytest.raises(ValueError, match="max_failure_rate"):
+        FailurePolicy(max_failure_rate=1.5)
+
+
+# -- abort circuit breaker -------------------------------------------------
+
+
+def test_abort_on_systemic_failure(space):
+    wl = get_workload("quadratic")
+    algo = RandomSearch(space, seed=0, max_trials=64, budget=5)
+    b = ScriptedBackend(wl, capacity=8, fail={i: "always" for i in range(64)})
+    with pytest.raises(SweepAborted, match="max_failure_rate"):
+        run_search(
+            algo,
+            b,
+            policy=FailurePolicy(max_failure_rate=0.5, min_evals_for_abort=16),
+        )
+    # the breaker tripped at the threshold, not after grinding all 64
+    assert len(b.attempts) < 64
+
+
+def test_abort_waits_for_min_evals(space):
+    """A tiny denominator must not trip the breaker: 2/2 failures is
+    100% but statistically meaningless."""
+    wl = get_workload("quadratic")
+    algo = RandomSearch(space, seed=0, max_trials=4, budget=5)
+    b = ScriptedBackend(wl, capacity=2, fail={0: "always", 1: "always"})
+    res = run_search(
+        algo,
+        b,
+        policy=FailurePolicy(max_failure_rate=0.5, min_evals_for_abort=20),
+    )
+    assert algo.finished()  # completed despite an early 100% failure rate
+    assert res.n_failed == 2
+
+
+def test_default_policy_never_aborts(space):
+    wl = get_workload("quadratic")
+    algo = RandomSearch(space, seed=0, max_trials=24, budget=5)
+    b = ScriptedBackend(wl, capacity=8, fail={i: "always" for i in range(24)})
+    res = run_search(algo, b)  # no policy: failures flow through
+    assert algo.finished()
+    assert res.n_failed == 24
+    assert algo.best() is None  # everything failed -> no usable best
+
+
+# -- per-algorithm failed-report handling ----------------------------------
+
+
+def test_asha_failed_rung_member_does_not_wedge(space):
+    """A failed rung member leaves the race: next_batch never raises the
+    driver's 'waiting on results that were never reported' error, the
+    sweep completes, and the failed trial is never promoted."""
+    wl = get_workload("quadratic")
+    algo = ASHA(space, seed=1, max_trials=9, min_budget=3, max_budget=27, eta=3)
+    b = ScriptedBackend(wl, capacity=3, fail={0: "always", 4: "always"})
+    res = run_search(algo, b)  # raises RuntimeError if ASHA wedges
+    assert algo.finished()
+    for tid in (0, 4):
+        assert algo.trials[tid].status == TrialStatus.FAILED
+        assert algo.trials[tid].rung == 0  # never promoted
+        assert tid not in algo.rung_scores[0]  # never entered the race
+    assert res.n_failed == 2
+    assert algo.best() is not None
+
+
+def test_asha_all_failed_terminates(space):
+    wl = get_workload("quadratic")
+    algo = ASHA(space, seed=1, max_trials=6, min_budget=3, max_budget=27, eta=3)
+    b = ScriptedBackend(wl, capacity=3, fail={i: "always" for i in range(6)})
+    run_search(algo, b)
+    assert algo.finished()
+    assert algo.best() is None
+
+
+def test_pbt_replaces_failed_members_next_generation(space):
+    wl = get_workload("quadratic")
+    algo = PBT(space, seed=2, population=8, generations=3, steps_per_generation=5)
+    # slots 0 and 3 of generation 0 fail (trial ids == slots in gen 0)
+    b = ScriptedBackend(wl, capacity=8, fail={0: "always", 3: "always"})
+    res = run_search(algo, b)
+    assert algo.finished()
+    assert res.n_failed == 2
+    assert algo.trials[0].status == TrialStatus.FAILED
+    # the failed members were exploited away: generation 1's occupants of
+    # slots 0 and 3 inherit from a SURVIVING generation-0 member
+    gen1 = [t for t in algo.trials.values() if 8 <= t.trial_id < 16]
+    by_slot = {t.params["__slot__"]: t for t in gen1}
+    for slot in (0, 3):
+        src = by_slot[slot].params["__inherit_from__"]
+        assert src is not None and src not in (0, 3)
+    assert algo.best() is not None and algo.best().status != TrialStatus.FAILED
+
+
+def test_random_tpe_best_never_failed(space):
+    for cls in (RandomSearch, TPE):
+        wl = get_workload("quadratic")
+        algo = cls(space, seed=3, max_trials=8, budget=5)
+        b = ScriptedBackend(wl, capacity=4, fail={0: "always", 2: "always"})
+        run_search(algo, b)
+        assert algo.finished()
+        best = algo.best()
+        assert best is not None
+        assert best.status != TrialStatus.FAILED
+        assert best.trial_id not in (0, 2)
+
+
+def test_tpe_failed_trials_stay_out_of_observation_ring(space):
+    algo = TPE(space, seed=3, max_trials=8, budget=5, n_startup=2)
+    ts = algo.next_batch(4)
+    algo.report_batch(
+        [failed_result(ts[0].trial_id, 5, "boom")]
+        + [TrialResult(t.trial_id, 0.5, 5) for t in ts[1:]]
+    )
+    assert algo._n_obs == 3  # the failure was never observed
+    assert algo._done == 4  # but it did count toward completion
+
+
+def test_hyperband_bohb_survive_failures(space):
+    for cls in (Hyperband, BOHB):
+        wl = get_workload("quadratic")
+        algo = cls(space, seed=4, max_budget=9, eta=3)
+        # fail a trial in each of the first two brackets (id_base 0 and 1e6)
+        b = ScriptedBackend(
+            wl, capacity=4, fail={0: "always", 1_000_000: "always"}
+        )
+        run_search(algo, b)
+        assert algo.finished()
+        best = algo.best()
+        assert best is not None
+        assert best.status != TrialStatus.FAILED
+
+
+def test_bohb_failed_scores_never_reach_model(space):
+    algo = BOHB(space, seed=5, max_budget=9, eta=3)
+    ts = algo.next_batch(4)
+    algo.report_batch(
+        [failed_result(ts[0].trial_id, 1, "boom")]
+        + [TrialResult(t.trial_id, 0.5, 1) for t in ts[1:]]
+    )
+    for store in algo.obs.budgets.values():
+        assert np.isfinite(store["score"][store["valid"]]).all()
+
+
+def test_failed_status_roundtrips_through_checkpoint(space):
+    algo = RandomSearch(space, seed=6, max_trials=4, budget=5)
+    ts = algo.next_batch(4)
+    algo.report_batch(
+        [failed_result(ts[0].trial_id, 5, "kaboom")]
+        + [TrialResult(t.trial_id, 0.1, 5) for t in ts[1:]]
+    )
+    state = algo.state_dict()
+    algo2 = RandomSearch(space, seed=0, max_trials=4, budget=5)
+    algo2.load_state_dict(state)
+    t = algo2.trials[ts[0].trial_id]
+    assert t.status == TrialStatus.FAILED
+    assert t.error == "kaboom"
+    assert algo2.best().trial_id != ts[0].trial_id
+
+
+def test_abort_batch_is_counted_in_trials(space):
+    """The aborting batch's evaluations reach metrics.trials_done even
+    though SweepAborted fires before the driver's own per-batch
+    accounting — operators compute failure fractions from
+    trials_failed / trials, so the denominator must include them."""
+    from mpi_opt_tpu.utils.metrics import MetricsLogger
+
+    wl = get_workload("quadratic")
+    algo = RandomSearch(space, seed=0, max_trials=64, budget=5)
+    b = ScriptedBackend(wl, capacity=8, fail={i: "always" for i in range(64)})
+    m = MetricsLogger()
+    with pytest.raises(SweepAborted):
+        run_search(
+            algo,
+            b,
+            metrics=m,
+            policy=FailurePolicy(max_failure_rate=0.5, min_evals_for_abort=16),
+        )
+    assert m.trials_done >= 16
+    assert m.trials_done == m.trials_failed  # every evaluation counted

@@ -77,3 +77,39 @@ def test_metrics_logger_per_chip_normalization(tmp_path):
     m.close()  # release the file handle (ResourceWarning-clean)
     rec = json.loads(path.read_text().splitlines()[0])
     assert rec["event"] == "batch" and rec["size"] == 8
+
+
+def test_summary_includes_failure_counters():
+    m = MetricsLogger()
+    m.count_trials(10)
+    m.count_failure("failed")
+    m.count_failure("failed")
+    m.count_failure("timeout")
+    m.count_retries(3)
+    s = m.summary()
+    assert s["trials"] == 10
+    assert s["trials_failed"] == 2
+    assert s["trials_timeout"] == 1
+    assert s["trials_retried"] == 3
+    # fresh loggers report explicit zeros (operators diff summaries)
+    z = MetricsLogger().summary()
+    assert (z["trials_failed"], z["trials_retried"], z["trials_timeout"]) == (0, 0, 0)
+
+
+def test_null_logger_log_path_is_sink_free(monkeypatch):
+    """null_logger() must stay zero-cost on the hot path: with no file
+    and no stream, log() must not serialize (the driver logs per-batch
+    and per-failure events unconditionally)."""
+    from mpi_opt_tpu.utils import metrics as metrics_mod
+    from mpi_opt_tpu.utils.metrics import null_logger
+
+    def boom(*a, **k):
+        raise AssertionError("json.dumps called on the null-logger path")
+
+    monkeypatch.setattr(metrics_mod.json, "dumps", boom)
+    m = null_logger()
+    rec = m.log("batch", size=4)
+    assert rec["event"] == "batch" and rec["size"] == 4
+    m.count_failure("timeout")
+    s = m.summary()
+    assert s["trials_timeout"] == 1

@@ -13,9 +13,16 @@ before the XLA backend exists, and the pytest process's backend is
 already up (and pinned to 8 virtual devices).
 """
 
+import pytest
+
 import socket
 import subprocess
 import sys
+
+# Subprocess SPMD bring-up (2 jax-importing worker processes per test):
+# out of the tier-1 870s single-process window — run explicitly or with
+# ``-m slow``
+pytestmark = pytest.mark.slow
 
 _WORKER = r"""
 import sys
@@ -25,7 +32,8 @@ import jax
 # per-process platform pinning must happen BEFORE initialize_multihost
 # (the axon sitecustomize pins JAX_PLATFORMS; config overrides it)
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+from mpi_opt_tpu.utils.hostdev import request_cpu_devices
+request_cpu_devices(2)  # compat: pre-0.5 jax has no jax_num_cpu_devices
 
 from mpi_opt_tpu.parallel.mesh import make_mesh, initialize_multihost
 
@@ -107,7 +115,8 @@ import sys
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+from mpi_opt_tpu.utils.hostdev import request_cpu_devices
+request_cpu_devices(2)  # compat: pre-0.5 jax has no jax_num_cpu_devices
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cpu")
 
 from mpi_opt_tpu.parallel.mesh import make_mesh, initialize_multihost
@@ -168,7 +177,8 @@ import sys
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+from mpi_opt_tpu.utils.hostdev import request_cpu_devices
+request_cpu_devices(2)  # compat: pre-0.5 jax has no jax_num_cpu_devices
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cpu")
 
 from mpi_opt_tpu.parallel.mesh import make_mesh, initialize_multihost

@@ -19,7 +19,14 @@ spanning 2 processes x 2 CPU devices and prints its result; the test
 asserts the output is IDENTICAL in both ranks (the SPMD contract).
 """
 
+import pytest
+
 from test_multihost import _run_two_procs
+
+# Subprocess SPMD sweeps (2 jax-importing worker processes per test):
+# out of the tier-1 870s single-process window — run explicitly or with
+# ``-m slow``
+pytestmark = pytest.mark.slow
 
 _PRELUDE = r"""
 import sys
@@ -27,7 +34,8 @@ import sys
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+from mpi_opt_tpu.utils.hostdev import request_cpu_devices
+request_cpu_devices(2)  # compat: pre-0.5 jax has no jax_num_cpu_devices
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cpu")
 
 from mpi_opt_tpu.parallel.mesh import make_mesh, initialize_multihost
@@ -148,7 +156,8 @@ import sys
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+from mpi_opt_tpu.utils.hostdev import request_cpu_devices
+request_cpu_devices(2)  # compat: pre-0.5 jax has no jax_num_cpu_devices
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cpu")
 
 pid, port = int(sys.argv[1]), sys.argv[2]

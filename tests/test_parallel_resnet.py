@@ -27,6 +27,11 @@ from mpi_opt_tpu.models import ResNet18
 from mpi_opt_tpu.parallel.mesh import make_mesh, pop_sharding, replicate
 from mpi_opt_tpu.train.population import OptHParams, PopulationTrainer
 
+# ResNet XLA:CPU compiles cost minutes of wall in one process — out
+# of the tier-1 870s single-process window; run explicitly or with
+# ``-m slow``
+pytestmark = pytest.mark.slow
+
 POP = 8
 
 

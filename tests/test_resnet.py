@@ -8,6 +8,11 @@ import pytest
 from mpi_opt_tpu.models import ResNet18
 from mpi_opt_tpu.workloads import get_workload
 
+# ResNet XLA:CPU compiles cost minutes of wall in one process — out
+# of the tier-1 870s single-process window; run explicitly or with
+# ``-m slow``
+pytestmark = pytest.mark.slow
+
 
 def _n_params(params):
     return sum(p.size for p in jax.tree.leaves(params))
