@@ -72,7 +72,11 @@ class ASHA(Algorithm):
         with host_ops():
             while len(out) < n and self._suggested < self.max_trials:
                 key = jax.random.fold_in(jax.random.key(self.seed), self._suggested)
-                unit = self._sample_fresh(key)
+                # warm-start points (ingest_observations) take the first
+                # fresh slots; they enter the rung race as ordinary
+                # lowest-rung trials and must earn their promotions
+                seed_u = self._next_seed_unit()
+                unit = seed_u if seed_u is not None else self._sample_fresh(key)
                 t = self._new_trial(unit, budget=self.rungs[0])
                 t.status = TrialStatus.RUNNING
                 out.append(t)
@@ -113,6 +117,11 @@ class ASHA(Algorithm):
         return (
             no_new and not self._promotable and not self._outstanding and not self._requeue
         )
+
+    def ingest_observations(self, observations):
+        # best() seeding: the prior's best point joins the first cohort
+        # at the lowest rung (cheap to verify, promoted only on merit)
+        return self._ingest_seed_points(observations)
 
     # -- fresh-trial sampling (overridable: BOHB swaps in a model) --------
 

@@ -19,6 +19,7 @@ on-device loop.
 from __future__ import annotations
 
 import abc
+import dataclasses
 from typing import Optional, Sequence
 
 import jax
@@ -26,6 +27,18 @@ import numpy as np
 
 from mpi_opt_tpu.space import SearchSpace
 from mpi_opt_tpu.trial import Trial, TrialResult, TrialStatus
+
+
+@dataclasses.dataclass(frozen=True)
+class Observation:
+    """One prior (point, score) fact offered to an algorithm as warm
+    start — NOT a trial of the current search. ``unit`` is the canonical
+    unit-cube row; ``budget`` is the step count the score was measured
+    at (budget-aware consumers like BOHB file it per-budget)."""
+
+    unit: np.ndarray
+    score: float
+    budget: int = 0
 
 
 def best_finite(items, key):
@@ -70,6 +83,7 @@ class Algorithm(abc.ABC):
         # the same hazard)
         self._next_id = id_base
         self._requeue: list[int] = []  # in-flight trials recovered from a checkpoint
+        self._seed_units: list[np.ndarray] = []  # warm-start points to try first
 
     # -- core contract ----------------------------------------------------
 
@@ -89,6 +103,40 @@ class Algorithm(abc.ABC):
     @abc.abstractmethod
     def finished(self) -> bool:
         """True when the search has no more work to hand out."""
+
+    # -- warm start (ledger/warmstart.py): the ingestion contract ---------
+
+    def ingest_observations(self, observations: Sequence[Observation]) -> int:
+        """Absorb prior-sweep observations BEFORE the search starts.
+
+        Contract: called at most once, before the first ``next_batch``;
+        observations are facts about THIS space (the caller has already
+        verified space compatibility via the space hash) but are NOT
+        trials of this search — they must not consume trial ids, budget
+        slots, or appear in ``best()``. Returns how many observations
+        actually informed the search, so callers can log an honest
+        count. The base default accepts none (0); model-based
+        algorithms override to build priors (TPE ring, BOHB per-budget
+        stores), samplers override to seed their first suggestions with
+        the prior's best points (``_ingest_seed_points``).
+        """
+        return 0
+
+    def _ingest_seed_points(self, observations: Sequence[Observation], k: int = 1) -> int:
+        """Shared best()-seeding: queue the top-``k`` finite-scored prior
+        points to be suggested before any fresh sampling. Non-finite
+        scores never seed (a diverged prior point is exactly what a new
+        sweep must not start from)."""
+        finite = [o for o in observations if np.isfinite(o.score)]
+        finite.sort(key=lambda o: o.score, reverse=True)
+        self._seed_units = [
+            np.asarray(o.unit, dtype=np.float32) for o in finite[:k]
+        ]
+        return len(self._seed_units)
+
+    def _next_seed_unit(self) -> Optional[np.ndarray]:
+        """Pop the next queued warm-start point (None when drained)."""
+        return self._seed_units.pop(0) if self._seed_units else None
 
     # -- shared bookkeeping ----------------------------------------------
 

@@ -161,6 +161,22 @@ class BOHB(Hyperband):
         # scheme single-sourced
         return _ModelBracket(self, **kw)
 
+    # -- warm start --------------------------------------------------------
+
+    def ingest_observations(self, observations):
+        """Prior observations file into the per-budget stores at their
+        recorded budgets (ObsStore drops non-finite scores itself), so a
+        budget that accumulates ``n_min`` priors puts the KDE in charge
+        of cohort sampling from bracket 0. Returns the finite count —
+        what actually informed the model."""
+        n = 0
+        for o in observations:
+            if not np.isfinite(o.score):
+                continue
+            self.obs.add(int(o.budget), np.asarray(o.unit, np.float32), float(o.score))
+            n += 1
+        return n
+
     # -- model ------------------------------------------------------------
 
     def _model_budget(self) -> int | None:

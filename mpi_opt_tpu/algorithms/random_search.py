@@ -23,6 +23,13 @@ class RandomSearch(Algorithm):
         self._suggested = 0
         self._done = 0
 
+    def ingest_observations(self, observations):
+        # warm start = try the prior sweep's best point before any
+        # random draw; the stream of random suggestions is unchanged
+        # (seeded points REPLACE draws positionally, and the fold-in
+        # counter keeps advancing per suggestion either way)
+        return self._ingest_seed_points(observations)
+
     def next_batch(self, n):
         out = []
         self._drain_requeue(out, n)
@@ -33,7 +40,8 @@ class RandomSearch(Algorithm):
             key = jax.random.fold_in(jax.random.key(self.seed), self._suggested)
             unit = np.asarray(self.space.sample_unit(key, take))
         for i in range(take):
-            t = self._new_trial(unit[i], budget=self.budget)
+            seed_u = self._next_seed_unit()
+            t = self._new_trial(seed_u if seed_u is not None else unit[i], budget=self.budget)
             t.status = TrialStatus.RUNNING
             out.append(t)
         self._suggested += take

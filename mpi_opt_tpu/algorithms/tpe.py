@@ -50,6 +50,24 @@ class TPE(Algorithm):
         self._done = 0
         self._suggest_fn = jax.jit(tpe_suggest, static_argnames=("n_suggest", "cfg"))
 
+    def ingest_observations(self, observations):
+        """Prior-sweep observations become surrogate priors: they fill
+        the observation ring exactly as live reports do, count toward
+        ``n_startup`` (enough priors engage the surrogate from the very
+        first suggestion), and never touch the trial ledger — they are
+        observations, not trials, so ``best()``/``n_trials``/budget
+        accounting are unaffected. Ascending score order: if the prior
+        overflows the ring, the wrap evicts the WORST observations."""
+        finite = [o for o in observations if np.isfinite(o.score)]
+        finite.sort(key=lambda o: o.score)
+        for o in finite:
+            slot = self._n_obs % self.buffer_size
+            self._obs_unit[slot] = np.asarray(o.unit, dtype=np.float32)
+            self._obs_score[slot] = o.score
+            self._valid[slot] = True
+            self._n_obs += 1
+        return len(finite)
+
     def next_batch(self, n):
         out = []
         self._drain_requeue(out, n)

@@ -41,6 +41,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--budget", type=int, default=100, help="steps per trial (random/tpe)")
     p.add_argument("--workers", type=int, default=0, help="cpu backend: processes (0=auto)")
     p.add_argument("--metrics-file", default=None, help="JSONL metrics output path")
+    # durable sweep ledger (ledger/ package; see README: sweep ledger)
+    p.add_argument(
+        "--ledger",
+        default=None,
+        metavar="PATH",
+        help="driver path: journal every FINAL trial result to this "
+        "JSONL file (fsync'd per record). With --resume, completed "
+        "records are replayed through the algorithm so a killed driver "
+        "resumes at the exact last completed trial, and an exact-match "
+        "params cache skips re-evaluating recorded-ok points",
+    )
+    p.add_argument(
+        "--warm-start",
+        default=None,
+        metavar="PATH",
+        help="driver path: feed a PRIOR sweep's ledger into this "
+        "algorithm as observations before the search starts (TPE/BOHB "
+        "build surrogate priors; random/asha seed their first "
+        "suggestions with the prior best). The prior must have run over "
+        "the same search space (checked by space hash)",
+    )
     # checkpoint/resume (SURVEY.md §2 row 13, §5)
     p.add_argument(
         "--checkpoint-dir",
@@ -531,6 +552,11 @@ def run_fused(args, parser, workload) -> int:
             )
     wall = time.perf_counter() - t0
     metrics.count_trials(n_trials)
+    # per-member failure visibility (ROADMAP open item): every fused
+    # sweep reports how many member evaluations came back non-finite
+    # per generation/rung — the divergence its isfinite winner picks
+    # mask. None only when a pre-upgrade snapshot hid the counts
+    member_failures = res.get("member_failures")
     summary = {
         "workload": args.workload,
         "algorithm": args.algorithm,
@@ -538,6 +564,7 @@ def run_fused(args, parser, workload) -> int:
         "mesh": None if mesh is None else dict(mesh.shape),
         "n_chips": n_chips,
         "n_trials": n_trials,
+        "member_failures": member_failures,
         "wall_s": round(wall, 3),
         "trials_per_sec_per_chip": round(n_trials / max(wall, 1e-9) / n_chips, 4),
         # best_params is None when the whole sweep diverged (all scores
@@ -552,16 +579,30 @@ def run_fused(args, parser, workload) -> int:
         else {k: v for k, v in res["best_params"].items() if not k.startswith("__")},
         **extra,
     }
-    metrics.summary(**{"final": True})
+    metrics.summary(
+        final=True,
+        member_failures=(
+            None if member_failures is None else int(sum(member_failures))
+        ),
+    )
     print(json.dumps(summary))
     return 0
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    # subcommand dispatch: `mpi_opt_tpu report ...` renders/validates
+    # ledgers and never touches jax; the flat sweep interface (the
+    # reference's mpirun-style surface) stays exactly as it was
+    if argv and argv[0] == "report":
+        from mpi_opt_tpu.ledger.report import report_main
+
+        return report_main(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
-    if args.resume and not args.checkpoint_dir:
-        parser.error("--resume requires --checkpoint-dir")
+    if args.resume and not (args.checkpoint_dir or args.ledger):
+        parser.error("--resume requires --checkpoint-dir or --ledger")
     # validate the failure-policy flags HERE so a bad value is a usage
     # error (exit 2), not a ValueError traceback from FailurePolicy or
     # the backend constructor deep in the run
@@ -573,6 +614,22 @@ def main(argv=None) -> int:
         )
     if args.trial_timeout is not None and args.trial_timeout <= 0:
         parser.error(f"--trial-timeout must be > 0, got {args.trial_timeout}")
+    if (args.ledger or args.warm_start) and args.fused:
+        parser.error(
+            "--ledger/--warm-start journal and replay per-trial driver "
+            "results; fused sweeps have no per-trial host loop (use "
+            "--checkpoint-dir for fused crash recovery)"
+        )
+    if args.warm_start and args.ledger:
+        import os
+
+        # realpath: './sweep.jsonl' vs 'sweep.jsonl' (or a symlink) is
+        # still self-feeding — this run's journal is not a prior sweep
+        if os.path.realpath(args.warm_start) == os.path.realpath(args.ledger):
+            parser.error(
+                "--warm-start must name a PRIOR sweep's ledger, not this "
+                "run's --ledger (resuming this sweep is --ledger --resume)"
+            )
     # platform pinning, then multi-host bring-up, BEFORE anything
     # touches the XLA backend (build_mesh, workload data, backend
     # construction all do) — both are only possible pre-initialization
@@ -676,16 +733,83 @@ def main(argv=None) -> int:
         n_chips = int(mesh.devices.size)
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
     checkpointer = None
+    restored_step = None
     if args.checkpoint_dir:
         from mpi_opt_tpu.utils.checkpoint import SearchCheckpointer
 
         checkpointer = SearchCheckpointer(args.checkpoint_dir, every=args.checkpoint_every)
         if args.resume:
-            step = checkpointer.restore_into(algorithm, backend)
-            metrics.log("resume", step=step)
+            restored_step = checkpointer.restore_into(algorithm, backend)
+            metrics.log("resume", step=restored_step)
     from mpi_opt_tpu.driver import FailurePolicy, SweepAborted
     from mpi_opt_tpu.utils.profiling import profile_window
 
+    # the prior ledger is VALIDATED (loaded, space-hash checked) before
+    # this run's own ledger header commits: a typo'd --warm-start path
+    # must fail before it is journaled into a fresh ledger's identity,
+    # which would refuse the corrected re-run
+    warm_obs = None
+    if args.warm_start:
+        from mpi_opt_tpu.ledger import LedgerError
+        from mpi_opt_tpu.ledger.warmstart import load_observations
+
+        try:
+            warm_obs = load_observations(args.warm_start, space)
+        except (LedgerError, OSError) as e:
+            parser.error(f"--warm-start: {e}")
+    ledger = None
+    if args.ledger:
+        from mpi_opt_tpu.ledger import LedgerError, SweepLedger
+
+        try:
+            ledger = SweepLedger(args.ledger)
+        except LedgerError as e:
+            parser.error(f"--ledger: {e}")
+        if ledger.records and not args.resume:
+            # explicit opt-in, same rule as --checkpoint-dir (ADVICE r2):
+            # a stale journal must not silently replay an old sweep
+            parser.error(
+                f"--ledger {args.ledger!r} already holds "
+                f"{len(ledger.records)} trial records; pass --resume to "
+                "replay them, or point at a fresh path"
+            )
+        try:
+            # the sweep's identity: everything that shapes the
+            # deterministic suggestion stream the replay relies on
+            ledger.ensure_header(
+                {
+                    "algorithm": args.algorithm,
+                    "workload": args.workload,
+                    "backend": args.backend,
+                    "seed": args.seed,
+                    "space_hash": space.space_hash(),
+                    "capacity": backend.capacity,
+                    "trials": args.trials,
+                    "budget": args.budget,
+                    "chaos": args.chaos,
+                    "warm_start": args.warm_start,
+                }
+            )
+        except LedgerError as e:
+            parser.error(f"--ledger: {e}")
+        if ledger.n_torn:
+            metrics.log("ledger_torn_tail_dropped", path=args.ledger)
+    if warm_obs is not None:
+        if restored_step is not None:
+            # the priors were ingested before that checkpoint was taken
+            # and live inside the restored state (TPE/BOHB ring buffers
+            # are checkpointed) — re-ingesting would double-weight them
+            # in the model and re-queue already-consumed seed points
+            metrics.log(
+                "warm_start_skipped",
+                reason="checkpoint restored (priors already in state)",
+                step=restored_step,
+            )
+        else:
+            n_warm = algorithm.ingest_observations(warm_obs)
+            metrics.log(
+                "warm_start", path=args.warm_start, observations=n_warm
+            )
     policy = FailurePolicy(
         max_retries=args.trial_retries,
         max_failure_rate=args.max_failure_rate,
@@ -699,6 +823,7 @@ def main(argv=None) -> int:
                 metrics=metrics,
                 checkpointer=checkpointer,
                 policy=policy,
+                ledger=ledger,
             )
     except SweepAborted as e:
         # the circuit breaker tripping is an OPERATOR outcome, not a
@@ -712,6 +837,8 @@ def main(argv=None) -> int:
         backend.close()
         if checkpointer is not None:
             checkpointer.close()
+        if ledger is not None:
+            ledger.close()
     best = result.best
     summary = {
         "workload": args.workload,
@@ -723,6 +850,8 @@ def main(argv=None) -> int:
         "trials_failed": metrics.trials_failed,
         "trials_retried": metrics.trials_retried,
         "trials_timeout": metrics.trials_timeout,
+        "cache_hits": metrics.cache_hits,
+        "replayed": metrics.replayed,
         "best_score": None if best is None else round(best.score, 6),
         "best_params": None
         if best is None

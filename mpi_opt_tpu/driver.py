@@ -18,6 +18,7 @@ from typing import Optional, Sequence
 
 from mpi_opt_tpu.algorithms.base import Algorithm
 from mpi_opt_tpu.backends.base import Backend
+from mpi_opt_tpu.ledger.store import result_from_record
 from mpi_opt_tpu.trial import Trial, TrialResult
 from mpi_opt_tpu.utils.metrics import MetricsLogger, null_logger
 
@@ -36,6 +37,11 @@ class SearchResult:
     n_failed: int = 0
     n_timeout: int = 0
     n_retried: int = 0
+    # ledger-layer tallies: results served without touching the backend
+    # (journal replay on resume / exact-match cache), disjoint from
+    # n_evals so throughput never counts un-run work
+    n_replayed: int = 0
+    n_cache_hits: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,11 +100,21 @@ class _FailureTracker:
         self.timeout = 0
         self.retried = 0
 
-    def evaluate(self, backend: Backend, batch: Sequence[Trial]) -> list[TrialResult]:
+    def evaluate(
+        self, backend: Backend, batch: Sequence[Trial], on_final=None
+    ) -> list[TrialResult]:
         """backend.evaluate with per-trial retries; returns FINAL results
-        aligned with ``batch`` order."""
+        aligned with ``batch`` order.
+
+        ``on_final(trial, result, attempts)`` fires once per trial with
+        its post-retry FINAL result, BEFORE the abort check can raise —
+        the ledger's journaling hook: an aborting batch's evaluations
+        must be durable even though run_search never returns them.
+        ``attempts`` is 1 + the retry rounds the trial re-entered.
+        """
         results = backend.evaluate(batch)
         final = {r.trial_id: r for r in results}
+        attempts = {t.trial_id: 1 for t in batch}
         if self.policy.max_retries > 0:
             by_id = {t.trial_id: t for t in batch}
             for attempt in range(1, self.policy.max_retries + 1):
@@ -117,9 +133,14 @@ class _FailureTracker:
                     trials=[t.trial_id for t in retry],
                     backoff_s=round(delay, 3),
                 )
+                for t in retry:
+                    attempts[t.trial_id] += 1
                 for r in backend.evaluate(retry):
                     final[r.trial_id] = r
         out = [final[t.trial_id] for t in batch]
+        if on_final is not None:
+            for t, r in zip(batch, out):
+                on_final(t, r, attempts[t.trial_id])
         self._account(out)
         return out
 
@@ -166,6 +187,8 @@ def run_search(
     max_batches: Optional[int] = None,
     checkpointer=None,
     policy: Optional[FailurePolicy] = None,
+    ledger=None,
+    cache=None,
 ) -> SearchResult:
     """Drive the suggest→evaluate→report loop to completion.
 
@@ -180,12 +203,48 @@ def run_search(
     raises ``SweepAborted`` on systemic failure. The default policy is
     no retries and no breaker, so failed trials flow straight through
     as FAILED reports.
+
+    ``ledger`` (ledger.store.SweepLedger, header already ensured)
+    journals every FINAL result fsync-durably before it is reported,
+    and REPLAYS the journal on resume: a suggested trial whose id holds
+    a final record is served from the journal (params-verified) without
+    touching the backend, so a killed driver resumes at the exact last
+    completed trial — finer-grained than, and composable with, the
+    batch-cadence ``checkpointer``. ``cache`` (ledger.cache.EvalCache)
+    is the exact-match params→result memo consulted before
+    ``backend.evaluate``; when a ledger is given and no cache, one is
+    built from the ledger's ok records automatically. Replay beats
+    cache: replay preserves the trial's recorded identity (including a
+    FINAL failure), the cache only ever serves ok results to NEW points.
     """
     metrics = metrics or null_logger()
     tracker = _FailureTracker(policy or FailurePolicy(), metrics)
+    replay: dict[int, dict] = {} if ledger is None else ledger.completed()
+    if cache is None and ledger is not None:
+        from mpi_opt_tpu.ledger.cache import EvalCache
+
+        cache = EvalCache(algorithm.space)
+        cache.seed_from(ledger.ok_records())
+    if replay:
+        metrics.log("ledger_replay", completed=len(replay))
+
+    def on_final(trial: Trial, result: TrialResult, attempts: int) -> None:
+        # journal BEFORE report/abort so the record can never lag the
+        # search state it will be replayed into
+        if ledger is not None:
+            ledger.record_trial(
+                result,
+                algorithm.space.canonical_params(trial.params),
+                attempts=attempts,
+            )
+        if cache is not None:
+            cache.put(trial.params, result)
+
     t0 = time.perf_counter()
     batches = 0
     n_run = 0  # trials evaluated by THIS run (metrics may be shared/reused)
+    n_replayed = 0
+    n_cache_hits = 0
     while not algorithm.finished():
         batch = algorithm.next_batch(backend.capacity)
         if not batch:
@@ -195,17 +254,49 @@ def run_search(
                 f"{algorithm.name}: no trials to run but search not finished "
                 "(algorithm is waiting on results that were never reported)"
             )
-        # tracker.evaluate owns metrics.count_trials for the batch (it
-        # must tally even a batch whose abort check raises)
-        results = tracker.evaluate(backend, batch)
-        algorithm.report_batch(results)
-        n_run += len(results)
+        served: dict[int, TrialResult] = {}
+        pending: list[Trial] = []
+        for t in batch:
+            rec = replay.pop(t.trial_id, None)
+            if rec is not None:
+                _verify_replay(algorithm.space, t, rec, ledger)
+                served[t.trial_id] = result_from_record(rec)
+                n_replayed += 1
+                metrics.count_replayed()
+                continue
+            if cache is not None:
+                hit = cache.get(t.params, t.budget, t.trial_id)
+                if hit is not None:
+                    served[t.trial_id] = hit
+                    n_cache_hits += 1
+                    metrics.count_cache_hits()
+                    # the hit is a FINAL ok result of THIS sweep too:
+                    # journal it (cached=True, attempts=0) so a later
+                    # resume replays it instead of re-consulting fate
+                    if ledger is not None:
+                        ledger.record_trial(
+                            hit,
+                            algorithm.space.canonical_params(t.params),
+                            attempts=0,
+                            cached=True,
+                        )
+                    continue
+            pending.append(t)
+        if pending:
+            # tracker.evaluate owns metrics.count_trials for the batch
+            # (it must tally even a batch whose abort check raises) and
+            # fires on_final per trial before that check
+            for r in tracker.evaluate(backend, pending, on_final=on_final):
+                served[r.trial_id] = r
+        algorithm.report_batch([served[t.trial_id] for t in batch])
+        n_run += len(pending)
         best = algorithm.best()
         metrics.log(
             "batch",
             algo=algorithm.name,
             backend=backend.name,
             size=len(batch),
+            evaluated=len(pending),
             best_score=None if best is None else round(best.score, 6),
         )
         batches += 1
@@ -213,6 +304,11 @@ def run_search(
             checkpointer.maybe_save(batches, algorithm, backend)
         if max_batches is not None and batches >= max_batches:
             break
+    if replay and algorithm.finished():
+        # journal records the resumed algorithm never re-suggested: not
+        # fatal (the search completed), but operators should know the
+        # ledger holds trials this configuration no longer produces
+        metrics.log("ledger_replay_unconsumed", trials=sorted(replay))
     wall = time.perf_counter() - t0
     return SearchResult(
         best=algorithm.best(),
@@ -223,4 +319,26 @@ def run_search(
         n_failed=tracker.failed - tracker.timeout,
         n_timeout=tracker.timeout,
         n_retried=tracker.retried,
+        n_replayed=n_replayed,
+        n_cache_hits=n_cache_hits,
     )
+
+
+def _verify_replay(space, trial: Trial, rec: dict, ledger) -> None:
+    """A replayed record must describe the SAME point the resumed
+    algorithm re-suggested under that trial id — algorithms re-derive
+    their suggestion streams deterministically from (seed, reports), so
+    a mismatch means the ledger belongs to a different configuration
+    than the header check could see (e.g. a code change shifted the
+    stream) and replaying it would corrupt the search."""
+    if space.params_key(trial.params) != space.params_key(rec["params"]):
+        from mpi_opt_tpu.ledger.store import LedgerError
+
+        raise LedgerError(
+            f"ledger replay diverged at trial {trial.trial_id}: journal "
+            f"records params {rec['params']} but the resumed search "
+            f"suggested {space.canonical_params(trial.params)} — the "
+            f"ledger{'' if ledger is None else ' ' + ledger.path} was "
+            "written by a different suggestion stream; resume with the "
+            "original configuration or start a fresh ledger"
+        )

@@ -1,0 +1,34 @@
+"""Durable sweep ledger: journaled trial history (SURVEY.md §5).
+
+The coordinator's trial history IS the product of a long HPO sweep, and
+this package makes it durable at TRIAL granularity: ``store.SweepLedger``
+appends one fsync'd JSONL record per FINAL TrialResult, the driver
+replays completed records through the algorithm on resume
+(``run_search(ledger=...)``), ``cache.EvalCache`` skips re-evaluating
+exactly-seen params, ``warmstart`` feeds a prior sweep's ledger into a
+new algorithm as observations, and ``report`` renders one-or-many
+ledgers for operators. Coarser-grained orbax snapshots
+(``utils.checkpoint``) keep backend/train-state duty; the ledger covers
+the gap between them — a crash between snapshots loses no completed
+evaluation.
+"""
+
+from mpi_opt_tpu.ledger.cache import EvalCache
+from mpi_opt_tpu.ledger.store import (
+    LEDGER_SCHEMA_VERSION,
+    LedgerError,
+    SweepLedger,
+    read_ledger,
+    validate_ledger,
+)
+from mpi_opt_tpu.ledger.warmstart import warm_start
+
+__all__ = [
+    "EvalCache",
+    "LEDGER_SCHEMA_VERSION",
+    "LedgerError",
+    "SweepLedger",
+    "read_ledger",
+    "validate_ledger",
+    "warm_start",
+]

@@ -1,0 +1,79 @@
+"""Exact-match params -> result memo over ledger history.
+
+HPO drivers re-see the same point more often than intuition suggests:
+a killed driver re-suggests its deterministic stream on resume, TPE
+exploitation collapses onto near-identical optima (discrete spaces make
+them EXACTLY identical), and operators re-run sweeps with overlapping
+seeds. An evaluation whose params match a journaled ok record to the
+canonical byte is the same deterministic computation — skip it and
+serve the recorded result.
+
+Only ``ok`` results are ever cached: a failure may be transient (the
+whole point of FailurePolicy retries), so serving a recorded failure
+would make one unlucky worker death permanent for those params.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from mpi_opt_tpu.space import SearchSpace
+from mpi_opt_tpu.trial import TrialResult
+
+
+class EvalCache:
+    """params-key -> (score, step, wall_s), keyed canonically.
+
+    The budget is part of the key: an ASHA trial evaluated to step 10 is
+    NOT the same computation as the same params run to step 270, so a
+    hit requires both the canonical params AND the granted budget to
+    match the recorded evaluation's reached step.
+    """
+
+    def __init__(self, space: SearchSpace):
+        self.space = space
+        self._memo: dict[tuple[str, int], dict] = {}
+        self.hits = 0
+
+    def _key(self, params: dict, budget: int) -> tuple[str, int]:
+        return (self.space.params_key(params), int(budget))
+
+    def seed_from(self, records: Sequence[dict]) -> int:
+        """Load ok trial records (ledger JSON shape); returns count."""
+        n = 0
+        for rec in records:
+            if rec.get("status") != "ok" or rec.get("score") is None:
+                continue
+            self._memo[self._key(rec["params"], rec["step"])] = {
+                "score": float(rec["score"]),
+                "step": int(rec["step"]),
+                "wall_s": float(rec.get("wall_s") or 0.0),
+            }
+            n += 1
+        return n
+
+    def put(self, params: dict, result: TrialResult) -> None:
+        if not result.ok:
+            return  # never cache non-ok results
+        self._memo[self._key(params, result.step)] = {
+            "score": float(result.score),
+            "step": int(result.step),
+            "wall_s": float(result.wall_time),
+        }
+
+    def get(self, params: dict, budget: int, trial_id: int) -> Optional[TrialResult]:
+        """A hit, rebuilt as an ok result under the asking trial's id."""
+        found = self._memo.get(self._key(params, budget))
+        if found is None:
+            return None
+        self.hits += 1
+        return TrialResult(
+            trial_id=trial_id,
+            score=found["score"],
+            step=found["step"],
+            wall_time=0.0,  # the recorded wall was paid by the original
+            extra={"cache_hit": True, "cached_wall_s": found["wall_s"]},
+        )
+
+    def __len__(self) -> int:
+        return len(self._memo)

@@ -1,0 +1,195 @@
+"""Post-hoc sweep reporting: render one-or-many ledgers for operators.
+
+``python -m mpi_opt_tpu report LEDGER [LEDGER ...]`` — best trial,
+score trajectory, failure/timeout/retry/cache breakdown, throughput;
+``--json`` for machines, ``--validate`` as the CI schema gate (exit 1
+on any malformed record, torn tail included — format drift should be
+caught by the suite, not by a resume failure in production).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from mpi_opt_tpu.ledger.store import LedgerError, read_ledger, validate_ledger
+
+# score trajectory rendered as a coarse unicode sparkline: enough to see
+# "when did the sweep stop improving" in a terminal without plotting
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: list[float], width: int = 32) -> str:
+    finite = [v for v in values if v == v]  # NaN-free
+    if not finite:
+        return ""
+    if len(values) > width:  # downsample evenly to terminal width
+        step = len(values) / width
+        values = [values[int(i * step)] for i in range(width)]
+    lo, hi = min(finite), max(finite)
+    span = (hi - lo) or 1.0
+    out = []
+    for v in values:
+        if v != v:
+            out.append(" ")
+        else:
+            out.append(_SPARK[int((v - lo) / span * (len(_SPARK) - 1))])
+    return "".join(out)
+
+
+def summarize_ledger(path: str) -> dict:
+    """One ledger -> its machine-readable report dict.
+
+    Raises LedgerError for files the tolerant loader refuses (malformed
+    mid-file records, missing header).
+    """
+    header, records, n_torn = read_ledger(path)
+    if header is None:
+        raise LedgerError(f"{path}: empty ledger (no header)")
+    cfg = header.get("config", {})
+    by_status = {"ok": 0, "failed": 0, "timeout": 0}
+    retried = cache_hits = 0
+    best: Optional[dict] = None
+    trajectory: list[float] = []  # running best over journal order
+    running = float("nan")
+    wall_sum = 0.0
+    for r in records:
+        by_status[r["status"]] += 1
+        if int(r.get("attempts") or 1) > 1:
+            retried += 1
+        if r.get("cached"):
+            cache_hits += 1
+        else:
+            wall_sum += float(r.get("wall_s") or 0.0)
+        if r["status"] == "ok" and r.get("score") is not None:
+            s = float(r["score"])
+            if best is None or s > float(best["score"]):
+                best = r
+                running = s
+        trajectory.append(running)
+    # journal timestamps bound the sweep's wall even across driver
+    # restarts (each record carries an absolute ts)
+    ts = [float(r["ts"]) for r in records if r.get("ts") is not None]
+    span = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+    n = len(records)
+    return {
+        "path": path,
+        "sweep_id": header.get("sweep_id"),
+        "version": header.get("version"),
+        "config": cfg,
+        "trials": n,
+        "by_status": by_status,
+        "retried": retried,
+        "cache_hits": cache_hits,
+        "torn_tail_dropped": n_torn,
+        "best": None
+        if best is None
+        else {
+            "trial_id": best["trial_id"],
+            "score": float(best["score"]),
+            "step": best["step"],
+            "params": best["params"],
+        },
+        "trajectory": trajectory,
+        "trials_per_sec": round(n / span, 4) if span > 0 else None,
+        "eval_wall_s": round(wall_sum, 3),
+    }
+
+
+def _render_text(rep: dict) -> str:
+    cfg = rep["config"]
+    lines = [
+        f"ledger {rep['path']}  (sweep {rep['sweep_id']}, schema v{rep['version']})",
+        "  config: "
+        + ", ".join(
+            f"{k}={cfg[k]}"
+            for k in ("algorithm", "workload", "backend", "seed")
+            if k in cfg
+        ),
+        f"  trials: {rep['trials']}  "
+        f"ok={rep['by_status']['ok']} failed={rep['by_status']['failed']} "
+        f"timeout={rep['by_status']['timeout']} retried={rep['retried']} "
+        f"cache_hits={rep['cache_hits']}",
+    ]
+    if rep["torn_tail_dropped"]:
+        lines.append("  note: 1 torn tail line dropped (crash mid-append)")
+    if rep["best"] is None:
+        lines.append("  best: none (no ok trial recorded)")
+    else:
+        b = rep["best"]
+        lines.append(
+            f"  best: trial {b['trial_id']} score {b['score']:.6f} "
+            f"@ step {b['step']}  {json.dumps(b['params'])}"
+        )
+    spark = _sparkline(rep["trajectory"])
+    if spark:
+        lines.append(f"  best-so-far: {spark}")
+    if rep["trials_per_sec"] is not None:
+        lines.append(
+            f"  throughput: {rep['trials_per_sec']} trials/s "
+            f"(eval wall {rep['eval_wall_s']}s)"
+        )
+    return "\n".join(lines)
+
+
+def report_main(argv=None) -> int:
+    """The ``mpi_opt_tpu report`` subcommand (see cli.main dispatch)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="mpi_opt_tpu report",
+        description="render durable sweep ledgers (see README: sweep ledger)",
+    )
+    p.add_argument("ledgers", nargs="+", metavar="LEDGER", help="ledger JSONL path(s)")
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--validate",
+        action="store_true",
+        help="strict schema check only: exit 1 on any malformed record "
+        "(torn tail included); no report is rendered",
+    )
+    args = p.parse_args(argv)
+
+    if args.validate:
+        rc = 0
+        out = {}
+        for path in args.ledgers:
+            problems = validate_ledger(path)
+            out[path] = problems
+            if problems:
+                rc = 1
+            if not args.json:
+                status = "ok" if not problems else "; ".join(problems)
+                print(f"{path}: {status}")
+        if args.json:
+            print(json.dumps({"valid": rc == 0, "problems": out}))
+        return rc
+
+    reports = []
+    rc = 0
+    for path in args.ledgers:
+        try:
+            reports.append(summarize_ledger(path))
+        except (LedgerError, OSError) as e:
+            print(f"{path}: {e}")
+            rc = 1
+    if args.json:
+        overall = None
+        cands = [r["best"] for r in reports if r["best"] is not None]
+        if cands:
+            overall = max(cands, key=lambda b: b["score"])
+        print(json.dumps({"ledgers": reports, "best": overall}))
+        return rc
+    for rep in reports:
+        print(_render_text(rep))
+    if len(reports) > 1:
+        cands = [
+            (r["path"], r["best"]) for r in reports if r["best"] is not None
+        ]
+        if cands:
+            path, b = max(cands, key=lambda pb: pb[1]["score"])
+            print(
+                f"overall best: score {b['score']:.6f} "
+                f"(trial {b['trial_id']} of {path})"
+            )
+    return rc

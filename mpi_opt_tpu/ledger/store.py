@@ -1,0 +1,289 @@
+"""Append-only, crash-safe journal of trial evaluations.
+
+File format (one JSON object per line):
+
+- line 1 — the HEADER record: ``{"kind": "header", "version": N,
+  "sweep_id": ..., "config": {...}, "created_ts": ...}``. ``config``
+  captures the sweep's identity (algorithm, workload, backend, seed,
+  space_hash, capacity, ...): a resume whose live config differs is a
+  DIFFERENT sweep and is refused, because replaying its records through
+  a differently-configured algorithm would silently corrupt the search.
+- every later line — one FINAL trial record: ``{"kind": "trial",
+  "trial_id", "params" (canonical, see SearchSpace.canonical_params),
+  "status" (ok|failed|timeout), "score" (null when non-finite — JSON has
+  no NaN), "step", "error", "attempts", "wall_s", "cached", "ts"}``.
+  FINAL means post-retry: the driver journals exactly one record per
+  completed trial, after its FailurePolicy has resolved.
+
+Durability contract: each record is flushed AND fsync'd before the
+driver reports it to the algorithm, so the journal can never lag the
+search state it will be replayed into. Recovery is tolerant of exactly
+the failure append-fsync can produce — a TORN FINAL LINE (the process
+died mid-write): the tail fragment is truncated away on load and the
+journal continues from the last complete record. A malformed line
+anywhere ELSE means the file was edited or mixed with another stream,
+and loading refuses rather than guessing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Optional, Sequence
+
+import numpy as np
+
+from mpi_opt_tpu.trial import TrialResult, failed_result
+
+LEDGER_SCHEMA_VERSION = 1
+
+
+class LedgerError(ValueError):
+    """Malformed or incompatible ledger content."""
+
+
+def _check_shape(rec, lineno: int) -> dict:
+    if not isinstance(rec, dict) or "kind" not in rec:
+        raise LedgerError(f"line {lineno}: not a ledger record (no 'kind')")
+    return rec
+
+
+def _check_trial_record(rec: dict, lineno: int) -> None:
+    missing = [k for k in ("trial_id", "params", "status", "step") if k not in rec]
+    if missing:
+        raise LedgerError(f"line {lineno}: trial record missing {missing}")
+    if rec["status"] not in ("ok", "failed", "timeout"):
+        raise LedgerError(f"line {lineno}: unknown status {rec['status']!r}")
+    if rec["status"] == "ok" and not isinstance(rec.get("score"), (int, float)):
+        raise LedgerError(f"line {lineno}: ok record without a numeric score")
+
+
+def read_ledger(path: str, strict: bool = False):
+    """(header, trial_records, n_torn) from a ledger file.
+
+    ``strict=False`` (load-for-resume): a torn FINAL line is dropped
+    (n_torn=1) — the one shape an append-crash leaves behind. Torn
+    means NOT-VALID-JSON specifically: a prefix of a longer JSON line
+    can never itself parse (the closing brace is the last byte), so
+    decode failure on the tail is the append-crash signature. A tail
+    line that PARSES but fails schema checks was written whole by
+    something else — edited, or another tool — and refuses to load
+    like any other malformed line (truncating it would destroy a
+    completed trial's data). ``strict=True`` (validate mode): every
+    line must parse, including the tail.
+    """
+    header: Optional[dict] = None
+    records: list[dict] = []
+    with open(path, "r") as f:
+        lines = f.read().split("\n")
+    if lines and lines[-1] == "":
+        lines.pop()  # the trailing newline of a cleanly-written file
+    for i, raw in enumerate(lines):
+        lineno = i + 1
+        is_tail = i == len(lines) - 1
+        try:
+            rec = json.loads(raw)
+        except json.JSONDecodeError as e:
+            if strict or not is_tail:
+                raise LedgerError(
+                    f"line {lineno}: not valid JSON ({e.msg})"
+                ) from None
+            return header, records, 1
+        _check_shape(rec, lineno)
+        if rec["kind"] == "header":
+            if lineno != 1:
+                raise LedgerError(f"line {lineno}: header must be line 1")
+            if int(rec.get("version", -1)) > LEDGER_SCHEMA_VERSION:
+                raise LedgerError(
+                    f"ledger schema v{rec['version']} is newer than this "
+                    f"build's v{LEDGER_SCHEMA_VERSION}"
+                )
+            header = rec
+        elif rec["kind"] == "trial":
+            _check_trial_record(rec, lineno)
+            records.append(rec)
+        else:
+            raise LedgerError(f"line {lineno}: unknown kind {rec['kind']!r}")
+    if lines and header is None:
+        raise LedgerError("line 1: not a ledger header")
+    return header, records, 0
+
+
+def validate_ledger(path: str) -> list[str]:
+    """Strict schema check; returns human-readable problems (empty = ok)."""
+    problems: list[str] = []
+    try:
+        header, records, _ = read_ledger(path, strict=True)
+    except LedgerError as e:
+        return [str(e)]
+    except OSError as e:
+        return [f"unreadable: {e}"]
+    if header is None:
+        problems.append("empty ledger (no header record)")
+    seen: set = set()
+    for rec in records:
+        tid = rec["trial_id"]
+        if tid in seen:
+            problems.append(f"trial {tid}: duplicated final record")
+        seen.add(tid)
+    return problems
+
+
+def result_from_record(rec: dict) -> TrialResult:
+    """Reconstruct the FINAL TrialResult a trial record journals.
+
+    Non-ok records come back through ``failed_result`` (the one
+    construction point for failures), so a replayed failure is
+    indistinguishable from a live one to the algorithm.
+    """
+    if rec["status"] != "ok":
+        return failed_result(
+            trial_id=int(rec["trial_id"]),
+            step=int(rec["step"]),
+            error=rec.get("error") or "replayed failure",
+            status=rec["status"],
+            wall_time=float(rec.get("wall_s") or 0.0),
+        )
+    return TrialResult(
+        trial_id=int(rec["trial_id"]),
+        score=float(rec["score"]),
+        step=int(rec["step"]),
+        wall_time=float(rec.get("wall_s") or 0.0),
+        extra={"replayed": True},
+    )
+
+
+class SweepLedger:
+    """One sweep's durable journal, opened for append.
+
+    Loading truncates a torn tail line IN PLACE (so the next append
+    starts on a clean line boundary) and exposes the completed records
+    for replay. ``ensure_header`` writes the header on a fresh file and
+    verifies identity on an existing one.
+    """
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        self.header: Optional[dict] = None
+        self.records: list[dict] = []
+        self.n_torn = 0
+        if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
+            self.header, self.records, self.n_torn = read_ledger(self.path)
+            if self.n_torn:
+                self._truncate_torn_tail()
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._file = open(self.path, "a")
+
+    def _truncate_torn_tail(self) -> None:
+        # keep exactly the bytes of the complete lines; the torn
+        # fragment must not prefix the next append
+        good = [json.dumps(self.header)] if self.header else []
+        good += [json.dumps(r) for r in self.records]
+        # rewrite-then-replace, not open('w'): a second crash here must
+        # not tear the GOOD records too
+        tmp = f"{self.path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write("".join(line + "\n" for line in good))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def sweep_id(self) -> Optional[str]:
+        return None if self.header is None else self.header.get("sweep_id")
+
+    def ensure_header(self, config: dict) -> None:
+        """Write the header (fresh ledger) or verify it (existing one).
+
+        ``config`` is the sweep's identity dict; on an existing ledger a
+        mismatch on any shared key is refused — the caller is about to
+        replay this journal through an algorithm configured differently
+        than the one that wrote it.
+        """
+        if self.header is not None:
+            stale = {
+                k: (self.header.get("config", {}).get(k), v)
+                for k, v in config.items()
+                if self.header.get("config", {}).get(k) != v
+            }
+            if stale:
+                diff = ", ".join(
+                    f"{k}: ledger={a!r} vs run={b!r}" for k, (a, b) in stale.items()
+                )
+                raise LedgerError(
+                    f"ledger {self.path} was written by a different sweep "
+                    f"({diff}) — resume with the original configuration or "
+                    "point --ledger at a fresh path"
+                )
+            return
+        self.header = {
+            "kind": "header",
+            "version": LEDGER_SCHEMA_VERSION,
+            "sweep_id": uuid.uuid4().hex[:12],
+            "config": dict(config),
+            "created_ts": round(time.time(), 4),
+        }
+        self._write_line(self.header)
+
+    # -- append ------------------------------------------------------------
+
+    def record_trial(
+        self,
+        result: TrialResult,
+        canonical_params: dict,
+        attempts: int = 1,
+        cached: bool = False,
+    ) -> dict:
+        """Journal one FINAL result; durable (fsync) before returning."""
+        if self.header is None:
+            raise LedgerError("ledger has no header — call ensure_header first")
+        score = float(result.score)
+        rec = {
+            "kind": "trial",
+            "sweep_id": self.sweep_id,
+            "trial_id": int(result.trial_id),
+            "params": canonical_params,
+            "status": result.status,
+            # JSON has no NaN: non-finite scores journal as null, and
+            # status carries the failure; result_from_record restores
+            # the NaN-family score via failed_result
+            "score": score if np.isfinite(score) else None,
+            "step": int(result.step),
+            "error": result.error,
+            "attempts": int(attempts),
+            "wall_s": round(float(result.wall_time), 4),
+            "cached": bool(cached),
+            "ts": round(time.time(), 4),
+        }
+        self._write_line(rec)
+        self.records.append(rec)
+        return rec
+
+    def _write_line(self, rec: dict) -> None:
+        self._file.write(json.dumps(rec) + "\n")
+        self._file.flush()
+        os.fsync(self._file.fileno())
+
+    # -- replay view -------------------------------------------------------
+
+    def completed(self) -> dict[int, dict]:
+        """trial_id -> FINAL record (ok or failed) for replay-resume."""
+        return {int(r["trial_id"]): r for r in self.records}
+
+    def ok_records(self) -> Sequence[dict]:
+        return [r for r in self.records if r["status"] == "ok"]
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

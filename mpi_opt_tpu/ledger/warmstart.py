@@ -1,0 +1,83 @@
+"""Feed a prior sweep's ledger into a new algorithm as observations.
+
+A finished (or even half-finished) sweep's journal is evidence about
+the objective surface; a NEW sweep over the SAME space should not start
+blind. ``warm_start`` converts a ledger's ok records into
+``Observation``s — unit-cube rows via the space's canonical params
+round trip — and hands them to ``Algorithm.ingest_observations``: TPE
+and BOHB build surrogate priors, random/ASHA seed their first
+suggestions with the prior best (see each algorithm's override).
+
+Space compatibility is checked by HASH, not by hope: a ledger written
+for a different space would decode its params into the wrong unit
+coordinates and silently poison the new search, so a mismatch raises.
+"""
+
+from __future__ import annotations
+
+from mpi_opt_tpu.algorithms.base import Algorithm, Observation
+from mpi_opt_tpu.ledger.store import LedgerError, read_ledger
+from mpi_opt_tpu.space import Choice, _plain
+
+
+def _decode_params(space, params: dict) -> dict:
+    """Journaled canonical params -> live typed params for ``space``.
+
+    Scalars round-trip as-is; Choice options were canonicalized through
+    ``_plain`` (exotic objects became their repr), so decoding matches
+    each journaled value against the canonical form of the live options
+    instead of feeding a repr STRING to ``value_to_index``.
+    """
+    out = dict(params)
+    for name, dom in space.domains.items():
+        if not isinstance(dom, Choice):
+            continue
+        v = params[name]
+        for opt in dom.options:
+            if _plain(opt) == v:
+                out[name] = opt
+                break
+        else:
+            raise LedgerError(
+                f"params[{name!r}] = {v!r} matches no option of {dom.options} "
+                "(same space hash but un-decodable Choice value)"
+            )
+    return out
+
+
+def load_observations(path: str, space) -> list[Observation]:
+    """A ledger's ok records as Observations for ``space``.
+
+    Raises LedgerError when the ledger has no header or was written for
+    a space whose hash differs from ``space``'s.
+    """
+    header, records, _ = read_ledger(path)
+    if header is None:
+        raise LedgerError(f"{path}: empty ledger, nothing to warm-start from")
+    theirs = header.get("config", {}).get("space_hash")
+    ours = space.space_hash()
+    if theirs != ours:
+        raise LedgerError(
+            f"{path}: ledger space hash {theirs!r} != this search's {ours!r} "
+            "— the prior sweep ran over a different search space, and its "
+            "params would decode into the wrong unit coordinates"
+        )
+    obs = []
+    for rec in records:
+        if rec["status"] != "ok" or rec.get("score") is None:
+            continue
+        obs.append(
+            Observation(
+                unit=space.params_to_unit(_decode_params(space, rec["params"])),
+                score=float(rec["score"]),
+                budget=int(rec["step"]),
+            )
+        )
+    return obs
+
+
+def warm_start(algorithm: Algorithm, path: str) -> int:
+    """Ingest a prior ledger into ``algorithm``; returns how many
+    observations actually informed it (the algorithm's own count)."""
+    obs = load_observations(path, algorithm.space)
+    return algorithm.ingest_observations(obs)

@@ -19,11 +19,28 @@ instead of per-trial Python objects.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
 from typing import Any, Mapping, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _plain(v):
+    """One value -> a plain JSON scalar (bool/int/float/str/None), repr
+    for anything exotic. Canonicalization rule shared by ``spec`` and
+    ``canonical_params``: a live value and its JSON round trip must
+    produce identical bytes (json floats round-trip exactly), so ledger
+    replay can verify params by key equality. bool first: it IS an int."""
+    if isinstance(v, (bool, str)) or v is None:
+        return v
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        return float(v)
+    return repr(v)
 
 
 class Domain:
@@ -229,6 +246,51 @@ class SearchSpace:
     def discrete_mask(self) -> np.ndarray:
         """bool[d]: which dims are discrete (used by TPE/PBT perturbation)."""
         return np.array([d.discrete for d in self.domains.values()])
+
+    # -- durable identity (ledger/warm-start; SURVEY.md §5) ---------------
+
+    def spec(self) -> list[dict]:
+        """JSON-able description of the space, in dimension order.
+
+        This is the space's DURABLE identity: the ledger header records
+        its hash so a resume or warm-start against a ledger written for
+        a different space is refused instead of silently misdecoding
+        unit rows. Dataclass fields capture each domain's full bounds;
+        Choice options go through ``_plain`` so non-JSON option objects
+        degrade to their repr deterministically.
+        """
+        out = []
+        for name, dom in self.domains.items():
+            d: dict[str, Any] = {"name": name, "kind": type(dom).__name__}
+            for f in dataclasses.fields(dom):
+                v = getattr(dom, f.name)
+                d[f.name] = [_plain(o) for o in v] if isinstance(v, tuple) else _plain(v)
+            out.append(d)
+        return out
+
+    def space_hash(self) -> str:
+        """Stable short digest of ``spec()`` (order- and value-exact)."""
+        payload = json.dumps(self.spec(), sort_keys=True)
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+    def canonical_params(self, params: Mapping[str, Any]) -> dict[str, Any]:
+        """One hparam dict -> its canonical JSON-able form.
+
+        Internal keys (``__``-prefixed driver plumbing like
+        ``__inherit_from__``) are dropped, keys are restricted to this
+        space's dimensions in insertion order, and values normalize to
+        plain JSON scalars — so the SAME point always serializes to the
+        SAME bytes whether it arrives live from ``materialize_row`` or
+        back from a ledger JSON round trip.
+        """
+        missing = [n for n in self.names if n not in params]
+        if missing:
+            raise KeyError(f"params missing dimensions {missing} of {self.names}")
+        return {name: _plain(params[name]) for name in self.names}
+
+    def params_key(self, params: Mapping[str, Any]) -> str:
+        """Canonical exact-match key for one point (ledger dedup cache)."""
+        return json.dumps(self.canonical_params(params), sort_keys=True)
 
     def __repr__(self):
         inner = ", ".join(f"{k}={v}" for k, v in self.domains.items())

@@ -290,6 +290,16 @@ def fused_sha(
         "stop_rung": stop_rung,
         "last_score": last_score,
         "rung_history": rung_history,
+        # per-rung diverged-member tallies (ROADMAP open item): the
+        # isfinite winner pick MASKS divergence, it must not HIDE it —
+        # operators need to see how many members each rung lost. From
+        # rung_history, so eager and deferred paths agree by
+        # construction; a pre-upgrade resume with partial history
+        # reports the rungs it has
+        "member_failures": [
+            int(np.sum(~np.isfinite(np.asarray(rh["scores"], dtype=np.float64))))
+            for rh in rung_history
+        ],
         "n_trials": n_trials,
     }
 
@@ -425,6 +435,9 @@ def fused_hyperband(
             "start_budget": r,
             "rung_sizes": res["rung_sizes"],
             "rung_budgets": res["rung_budgets"],
+            # .get: minimal bracket-result stubs (tests) and any cached
+            # pre-upgrade result dicts simply report no tallies
+            "member_failures": res.get("member_failures", []),
             "best_score": res["best_score"],
         }
         if cohort_fn is not None:
@@ -442,5 +455,10 @@ def fused_hyperband(
         "best_score": best["best_score"],
         "best_params": best["best_params"],
         "brackets": brackets,
+        # flattened across brackets in bracket order, so the CLI summary
+        # can report one per-generation-shaped list for every fused algo
+        "member_failures": [
+            n for s in brackets for n in s["member_failures"]
+        ],
         "n_trials": n_total,
     }

@@ -56,7 +56,13 @@ def run_fused_pbt(
     steps_per_gen: int = 100,
     cfg: PBTConfig = PBTConfig(),
 ):
-    """Returns (state, unit, key', best_curve[G], mean_curve[G], final_scores[P]).
+    """Returns (state, unit, key', best_curve[G], mean_curve[G],
+    member_fail[G], final_scores[P]).
+
+    ``member_fail`` counts the PRE-exploit members whose eval came back
+    non-finite each generation — the divergence the exploit step then
+    masks by replacing losers with winners. Tallied in-scan (one int32
+    per generation) so reporting it costs no extra fetch.
 
     ``key'`` is the scan-carried RNG key after ``generations`` steps of
     the chain — feeding it into a following call continues the EXACT
@@ -78,12 +84,13 @@ def run_fused_pbt(
         # the post-exploit population's scores are exactly the gathered
         # pre-exploit scores (weights are copied verbatim, eval is
         # deterministic) — so no final re-eval is ever needed
-        return (st, new_u, k), (scores.max(), scores.mean(), scores[src_idx])
+        n_fail = jnp.sum(~jnp.isfinite(scores)).astype(jnp.int32)
+        return (st, new_u, k), (scores.max(), scores.mean(), n_fail, scores[src_idx])
 
-    (state, unit, key), (best, mean, gen_scores) = jax.lax.scan(
+    (state, unit, key), (best, mean, fails, gen_scores) = jax.lax.scan(
         one_generation, (state, unit, key), jnp.arange(generations)
     )
-    return state, unit, key, best, mean, gen_scores[-1]
+    return state, unit, key, best, mean, fails, gen_scores[-1]
 
 
 def _balanced_split(total: int, chunk: int) -> list[int]:
@@ -118,12 +125,13 @@ def finish_generation(
     population, run exploit/explore, gather winner states — the tail of
     ``run_fused_pbt.one_generation`` without the training scan (which
     ran as separate ``train_segment`` launches). Returns
-    (state, unit, best, mean, post_exploit_scores)."""
+    (state, unit, best, mean, n_fail, post_exploit_scores)."""
     disc = jnp.asarray(discrete_mask, dtype=bool)
     scores = trainer.eval_population(state, val_x, val_y)
     new_u, src_idx, _ = pbt_exploit_explore(key, unit, scores, disc, cfg)
     state = trainer.gather_members(state, src_idx)
-    return state, new_u, scores.max(), scores.mean(), scores[src_idx]
+    n_fail = jnp.sum(~jnp.isfinite(scores)).astype(jnp.int32)
+    return state, new_u, scores.max(), scores.mean(), n_fail, scores[src_idx]
 
 
 def _run_stepped_generation(
@@ -158,10 +166,10 @@ def _run_stepped_generation(
         state, _ = trainer.train_segment(
             state, hp, train_x, train_y, jax.random.fold_in(k_train, i), s
         )
-    state, unit, best, mean, gen_scores = finish_generation(
+    state, unit, best, mean, n_fail, gen_scores = finish_generation(
         trainer, state, unit, k_pbt, val_x, val_y, discrete_mask=disc, cfg=cfg
     )
-    return state, unit, key, best[None], mean[None], gen_scores
+    return state, unit, key, best[None], mean[None], n_fail[None], gen_scores
 
 
 def fused_pbt(
@@ -266,6 +274,8 @@ def fused_pbt(
     restored = None
     start_launch = 0
     best_parts, mean_parts = [], []
+    fail_parts: list = []  # per-gen diverged-member counts per launch
+    fails_complete = True  # False when resuming a pre-tally snapshot
     launch_walls: list = []  # seconds per completed launch (excl. snapshot saves)
     walls_complete = True  # False when resuming a pre-duration-recording snapshot
     scores = None
@@ -316,6 +326,13 @@ def fused_pbt(
                 launch_walls = [float(w) for w in meta["launch_walls"]]
             else:
                 walls_complete = False
+            # same pre-upgrade rule as launch_walls: a snapshot written
+            # before member-failure tallies existed cannot supply the
+            # completed launches' counts — report None, never invent
+            if "member_fail" in meta:
+                fail_parts = [np.asarray(v, dtype=np.int32) for v in meta["member_fail"]]
+            else:
+                fails_complete = False
     if restored is None:
         unit = space.sample_unit(k_unit, population)
         state = trainer.init_population(k_init, train_x[:2], population)
@@ -341,7 +358,7 @@ def fused_pbt(
             if step_chunk > 0:
                 # one generation as k sub-segment launches + a boundary
                 # launch; the carried key advances exactly once per gen
-                state, unit, k_run, best, mean, final_scores = _run_stepped_generation(
+                state, unit, k_run, best, mean, fails, final_scores = _run_stepped_generation(
                     trainer,
                     state,
                     unit,
@@ -360,7 +377,7 @@ def fused_pbt(
                 # k_run is the scan-carried key returned by the previous
                 # launch: the chain continues exactly as one longer scan
                 # would
-                state, unit, k_run, best, mean, final_scores = run_fused_pbt(
+                state, unit, k_run, best, mean, fails, final_scores = run_fused_pbt(
                     trainer,
                     state,
                     unit,
@@ -380,6 +397,7 @@ def fused_pbt(
             # under multi-process SPMD these are global arrays)
             best_parts.append(fetch_global(best))
             mean_parts.append(fetch_global(mean))
+            fail_parts.append(fetch_global(fails))
             scores = fetch_global(final_scores)
             # the fetches above are the launch's completion barrier
             # (block_until_ready is unreliable under the axon plugin —
@@ -396,6 +414,9 @@ def fused_pbt(
                     "best": [v.tolist() for v in best_parts],
                     "mean": [v.tolist() for v in mean_parts],
                 }
+                if fails_complete:
+                    # an incomplete set must stay absent (see launch_walls)
+                    meta_extra["member_fail"] = [v.tolist() for v in fail_parts]
                 if walls_complete:
                     # an incomplete set must stay absent: writing the
                     # post-resume tail alone would misalign the NEXT
@@ -423,6 +444,13 @@ def fused_pbt(
         "diverged": diverged,
         "best_curve": np.asarray(best),
         "mean_curve": np.asarray(mean),
+        # per-generation diverged-member tallies (ROADMAP open item):
+        # how many members each exploit step silently replaced for
+        # non-finite scores. None when a pre-upgrade snapshot left the
+        # completed launches' counts unknown
+        "member_failures": (
+            [int(v) for v in np.concatenate(fail_parts)] if fails_complete else None
+        ),
         "state": state,
         "unit": np_unit,
         # measured per-launch durations + generation split, for

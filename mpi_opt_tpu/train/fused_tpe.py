@@ -136,6 +136,8 @@ def fused_tpe(
     start_gen = 0
     done = 0
     best_curve = []
+    member_fail: list = []  # per-gen diverged-suggestion counts
+    fails_complete = True
     if checkpoint_dir is not None:
         import dataclasses
 
@@ -169,6 +171,12 @@ def fused_tpe(
             start_gen = int(meta["gens_done"])
             done = sum(sizes[:start_gen])
             best_curve = [float(v) for v in meta["best_curve"]]
+            # pre-upgrade snapshots have no per-gen failure tallies for
+            # the completed generations: report None, never invent
+            if "member_fail" in meta:
+                member_fail = [int(v) for v in meta["member_fail"]]
+            else:
+                fails_complete = False
 
     from mpi_opt_tpu.parallel.mesh import fetch_global
 
@@ -181,6 +189,7 @@ def fused_tpe(
     # barrier that launch-granular wall-to-target accounting needs.
     defer = snap is None
     curve_dev: list = []
+    fail_dev: list = []
     try:
         for g in range(start_gen, len(sizes)):
             obs_unit, obs_scores, valid, key, scores, _ = tpe_generation(
@@ -206,12 +215,18 @@ def fused_tpe(
             running_dev = jnp.max(
                 jnp.where(valid & jnp.isfinite(obs_scores), obs_scores, -jnp.inf)
             )
+            # this generation's diverged-suggestion count (ROADMAP open
+            # item): the obs ring masks non-finite scores from the model,
+            # but operators need the tally the masking hides
+            fail_dev_g = jnp.sum(~jnp.isfinite(scores)).astype(jnp.int32)
             if defer:
                 curve_dev.append(running_dev)
+                fail_dev.append(fail_dev_g)
             else:
                 # fetch_global: under multi-process SPMD the buffer is a
                 # process-spanning (replicated) global array
                 best_curve.append(float(fetch_global(running_dev)))
+                member_fail.append(int(fetch_global(fail_dev_g)))
             if snap is not None:
                 # fetch_global for the payload too — np.asarray on the
                 # process-spanning buffers raises, killing the sweep at
@@ -224,16 +239,22 @@ def fused_tpe(
                         "valid": fetch_global(valid),
                         "key_data": np.asarray(jax.random.key_data(key)),
                     },
-                    meta_extra={"gens_done": g + 1, "best_curve": best_curve},
+                    meta_extra={
+                        "gens_done": g + 1,
+                        "best_curve": best_curve,
+                        **({"member_fail": member_fail} if fails_complete else {}),
+                    },
                 )
     finally:
         if snap is not None:
             snap.close()
 
-    if curve_dev:
+    if curve_dev or fail_dev:
         from mpi_opt_tpu.parallel.mesh import fetch_global_batched
 
-        best_curve.extend(float(v) for v in fetch_global_batched(curve_dev))
+        fetched = fetch_global_batched(curve_dev + fail_dev)
+        best_curve.extend(float(v) for v in fetched[: len(curve_dev)])
+        member_fail.extend(int(v) for v in fetched[len(curve_dev):])
     np_unit = fetch_global(obs_unit)
     raw_scores = fetch_global(obs_scores)
     np_scores = np.asarray(raw_scores)
@@ -249,6 +270,9 @@ def fused_tpe(
         "best_params": None if diverged else space.materialize_row(np_unit[best_i]),
         "diverged": diverged,
         "best_curve": np.asarray(best_curve, dtype=np.float32),
+        # per-generation diverged-suggestion tallies; None when a
+        # pre-upgrade snapshot left completed generations' counts unknown
+        "member_failures": member_fail if fails_complete else None,
         "obs_unit": np_unit,
         "obs_scores": raw_scores,
         "n_trials": n_trials,

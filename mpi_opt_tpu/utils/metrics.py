@@ -26,9 +26,22 @@ class MetricsLogger:
         self.trials_failed = 0
         self.trials_timeout = 0
         self.trials_retried = 0
+        # ledger-layer counters: evaluations SKIPPED (served from the
+        # journal on resume / from the exact-match cache), disjoint from
+        # trials_done so throughput never counts un-run work
+        self.cache_hits = 0
+        self.replayed = 0
 
     def log(self, event: str, **fields) -> dict:
-        rec = {"event": event, "t": round(time.perf_counter() - self.t_start, 4), **fields}
+        # `t` is relative (this process's clock, for intra-run deltas);
+        # `ts` is absolute unix epoch so multi-process/multi-host streams
+        # can be correlated after the fact
+        rec = {
+            "event": event,
+            "t": round(time.perf_counter() - self.t_start, 4),
+            "ts": round(time.time(), 4),
+            **fields,
+        }
         if self._file or self._stream:  # null_logger: no sink, no json cost
             line = json.dumps(rec)
             if self._file:
@@ -51,6 +64,14 @@ class MetricsLogger:
     def count_retries(self, n: int = 1):
         self.trials_retried += n
 
+    def count_cache_hits(self, n: int = 1):
+        """Evaluations skipped by the exact-match ledger cache."""
+        self.cache_hits += n
+
+    def count_replayed(self, n: int = 1):
+        """FINAL results served from the journal on replay-resume."""
+        self.replayed += n
+
     @property
     def wall(self) -> float:
         return time.perf_counter() - self.t_start
@@ -65,6 +86,8 @@ class MetricsLogger:
             trials_failed=self.trials_failed,
             trials_retried=self.trials_retried,
             trials_timeout=self.trials_timeout,
+            cache_hits=self.cache_hits,
+            replayed=self.replayed,
             wall_s=round(self.wall, 3),
             trials_per_sec_per_chip=round(self.trials_per_sec_per_chip(), 4),
             **extra,

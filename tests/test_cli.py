@@ -1,6 +1,7 @@
 """CLI end-to-end: the config-1 minimum slice, in-process."""
 
 import json
+import os
 
 import jax.errors
 
@@ -558,6 +559,204 @@ def test_cli_chaos_rejects_tpu_backend(capsys):
             "--trials", "2", "--chaos", "exc=0.5",
         ])
     assert "cpu backend" in capsys.readouterr().err
+
+
+def test_fused_summary_reports_member_failures(capsys):
+    """Every fused sweep's summary carries the per-generation diverged-
+    member tallies (ROADMAP open item) — zero for a healthy sweep, but
+    PRESENT, so operators can alarm on it."""
+    rc = main(
+        [
+            "--workload", "fashion_mlp",
+            "--algorithm", "pbt",
+            "--fused",
+            "--population", "8",
+            "--generations", "2",
+            "--steps-per-generation", "5",
+            "--seed", "0",
+            "--no-mesh",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    summary = _summary_from(out)
+    assert summary["member_failures"] == [0, 0]
+    # ...and the metrics summary event carries the total
+    events = [json.loads(l) for l in out.splitlines() if '"event": "summary"' in l]
+    assert events[-1]["member_failures"] == 0
+
+
+# -- durable sweep ledger (--ledger / --warm-start / report) ---------------
+
+
+LEDGER_ARGS = [
+    "--workload", "quadratic",
+    "--algorithm", "random",
+    "--trials", "10",
+    "--budget", "20",
+    "--workers", "1",
+    "--seed", "0",
+]
+
+
+def test_cli_ledger_journals_and_resumes(capsys, tmp_path):
+    """--ledger end-to-end: journal a sweep, refuse a stale ledger
+    without --resume, replay it fully with --resume (zero evaluations),
+    and report the same best."""
+    led = str(tmp_path / "sweep.jsonl")
+    assert main(LEDGER_ARGS + ["--ledger", led]) == 0
+    first = _summary(capsys)
+    lines = open(led).read().splitlines()
+    assert len(lines) == 11  # header + one record per trial
+    assert json.loads(lines[0])["kind"] == "header"
+
+    with pytest.raises(SystemExit):  # stale ledger, no --resume: refuse
+        main(LEDGER_ARGS + ["--ledger", led])
+    assert "pass --resume" in capsys.readouterr().err
+
+    assert main(LEDGER_ARGS + ["--ledger", led, "--resume"]) == 0
+    resumed = _summary(capsys)
+    assert resumed["replayed"] == 10
+    assert resumed["best_score"] == pytest.approx(first["best_score"], abs=1e-12)
+    # a full replay journals nothing new
+    assert len(open(led).read().splitlines()) == 11
+
+
+def test_cli_ledger_refuses_config_drift(capsys, tmp_path):
+    led = str(tmp_path / "sweep.jsonl")
+    assert main(LEDGER_ARGS + ["--ledger", led]) == 0
+    capsys.readouterr()
+    with pytest.raises(SystemExit):
+        main(LEDGER_ARGS[:-1] + ["7", "--ledger", led, "--resume"])  # other seed
+    assert "different sweep" in capsys.readouterr().err
+
+
+def test_cli_warm_start_and_space_check(capsys, tmp_path):
+    led = str(tmp_path / "prior.jsonl")
+    assert main(LEDGER_ARGS + ["--ledger", led]) == 0
+    prior = _summary(capsys)
+    # a warm-started sweep over the same space runs fine and its first
+    # suggestion is the prior best (seed 1 would otherwise sample fresh)
+    rc = main(
+        [
+            "--workload", "quadratic", "--algorithm", "random",
+            "--trials", "4", "--budget", "20", "--workers", "1",
+            "--seed", "1", "--warm-start", led,
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    warm = _summary_from(out)
+    assert '"event": "warm_start"' in out
+    assert warm["best_score"] >= prior["best_score"] - 1e-9
+    # a different workload = different space: refused via the space hash
+    with pytest.raises(SystemExit):
+        main(
+            [
+                "--workload", "digits", "--algorithm", "random",
+                "--trials", "2", "--workers", "1", "--warm-start", led,
+            ]
+        )
+    assert "space hash" in capsys.readouterr().err
+
+
+def test_cli_ledger_flag_validation(capsys, tmp_path):
+    led = str(tmp_path / "l.jsonl")
+    for argv, msg in (
+        (
+            ["--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+             "--population", "4", "--generations", "1", "--ledger", led],
+            "per-trial host loop",
+        ),
+        (
+            ["--workload", "quadratic", "--trials", "2",
+             "--ledger", led, "--warm-start", led],
+            "PRIOR sweep",
+        ),
+        (
+            # a path ALIAS of the same file is still self-feeding
+            ["--workload", "quadratic", "--trials", "2", "--ledger", led,
+             "--warm-start", str(tmp_path / "." / "l.jsonl")],
+            "PRIOR sweep",
+        ),
+    ):
+        with pytest.raises(SystemExit) as exc:
+            main(argv)
+        assert exc.value.code == 2
+        assert msg in capsys.readouterr().err
+
+
+def test_cli_bad_warm_start_does_not_wedge_fresh_ledger(capsys, tmp_path):
+    """--warm-start is validated BEFORE the new ledger's header commits:
+    a typo'd prior path must not journal itself into the fresh ledger's
+    identity (which would refuse the corrected re-run)."""
+    led = str(tmp_path / "new.jsonl")
+    with pytest.raises(SystemExit) as exc:
+        main(LEDGER_ARGS + ["--ledger", led, "--warm-start", str(tmp_path / "typo.jsonl")])
+    assert exc.value.code == 2
+    assert "--warm-start" in capsys.readouterr().err
+    assert not os.path.exists(led)  # nothing was committed
+    # the corrected re-run works with the same --ledger path
+    prior = str(tmp_path / "prior.jsonl")
+    assert main(LEDGER_ARGS + ["--ledger", prior]) == 0
+    capsys.readouterr()
+    assert main(LEDGER_ARGS + ["--ledger", led, "--warm-start", prior]) == 0
+    capsys.readouterr()
+
+
+def test_cli_warm_start_not_reingested_on_checkpoint_resume(capsys, tmp_path):
+    """Priors ingested before a checkpoint live inside the restored
+    state (TPE's obs ring is checkpointed): a --resume re-run must skip
+    re-ingestion instead of double-weighting them."""
+    prior = str(tmp_path / "prior.jsonl")
+    assert main(LEDGER_ARGS + ["--ledger", prior]) == 0
+    capsys.readouterr()
+    ck = str(tmp_path / "ck")
+    base = [
+        "--workload", "quadratic", "--algorithm", "tpe",
+        "--trials", "6", "--budget", "20", "--workers", "1", "--seed", "3",
+        "--warm-start", prior, "--checkpoint-dir", ck,
+    ]
+    assert main(base) == 0
+    assert '"event": "warm_start"' in capsys.readouterr().out
+    out2 = None
+    assert main(base + ["--resume"]) == 0
+    out2 = capsys.readouterr().out
+    assert '"event": "warm_start_skipped"' in out2
+    assert '"event": "warm_start"' not in out2.replace("warm_start_skipped", "X")
+
+
+def test_report_subcommand_text_json_and_validate(capsys, tmp_path):
+    """`mpi_opt_tpu report`: renders a ledger, --json machine mode, and
+    --validate as the CI schema gate (exit 1 on malformed records) —
+    this test IS the tier-1 wiring that catches ledger-format drift."""
+    led = str(tmp_path / "sweep.jsonl")
+    # chaos seed 4 injects 4 exc faults over this 10-trial capacity-1
+    # stream (faults are a pure function of (seed, params), so the
+    # count is stable across machines)
+    assert main(LEDGER_ARGS + ["--ledger", led, "--chaos", "exc=0.2,seed=4"]) == 0
+    sweep = _summary(capsys)
+
+    assert main(["report", led]) == 0
+    out = capsys.readouterr().out
+    assert "best:" in out and "failed=" in out
+
+    assert main(["report", led, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    one = rep["ledgers"][0]
+    assert one["trials"] == 10
+    assert one["by_status"]["failed"] > 0  # the chaos drill's injections
+    assert one["by_status"]["ok"] + one["by_status"]["failed"] == 10
+    # the sweep summary rounds to 6 decimals; the report keeps full precision
+    assert rep["best"]["score"] == pytest.approx(sweep["best_score"], abs=1e-6)
+
+    assert main(["report", led, "--validate"]) == 0
+
+    # any malformed record (torn tail included) fails validation loudly
+    with open(led, "a") as f:
+        f.write('{"kind": "trial", "trial_id": 99, "trunc')
+    assert main(["report", led, "--validate"]) == 1
+    capsys.readouterr()
 
 
 def test_cli_validates_failure_policy_flags(capsys):

@@ -36,6 +36,9 @@ def test_fused_pbt_nan_survivor_does_not_hijack(monkeypatch):
     assert r["diverged"] is False
     assert r["best_score"] == pytest.approx(0.9)
     assert r["best_params"] is not None
+    # the divergence the exploit step masked is REPORTED, not hidden:
+    # both NaN members count in the per-generation tally (ROADMAP item)
+    assert r["member_failures"] == [2]
 
 
 def test_fused_pbt_all_nan_reports_diverged(monkeypatch):
@@ -48,6 +51,23 @@ def test_fused_pbt_all_nan_reports_diverged(monkeypatch):
     assert r["diverged"] is True
     assert r["best_params"] is None
     assert np.isnan(r["best_score"])
+    assert r["member_failures"] == [4]
+
+
+def test_fused_sha_counts_member_failures_per_rung(monkeypatch):
+    """The single-rung (fused random) case: diverged members are tallied
+    per rung in the result, exactly what the isfinite winner pick
+    masks. Shared rung_history sourcing keeps the eager and deferred
+    fetch paths in agreement by construction."""
+    from mpi_opt_tpu.train.fused_asha import fused_sha
+
+    wl = _wl()
+    trainer, *_ = workload_arrays(wl)
+    scores = jnp.asarray([0.9, jnp.nan, jnp.nan, 0.4])
+    monkeypatch.setattr(trainer, "eval_population", lambda *a, **k: scores)
+    r = fused_sha(wl, n_trials=4, min_budget=2, max_budget=2, seed=0)
+    assert r["member_failures"] == [2]
+    assert r["best_score"] == pytest.approx(0.9)
 
 
 def _nan_row_injector(real, rows):
