@@ -12,9 +12,17 @@ Two paths:
 - stateless (random/TPE/ASHA from-scratch): trials fan out to a process
   pool; the workload is reconstructed in each worker by registry name so
   nothing unpicklable crosses the fork.
-- stateful (PBT inheritance / ASHA warm resume): states are kept in the
-  parent and training runs in-process — correct but sequential;
-  the TPU population backend is the fast path for these.
+- stateful (PBT inheritance / ASHA warm resume): training states must
+  persist between evaluations. By default they live in the parent and
+  training runs in-process — correct but sequential, and structurally
+  UNINTERRUPTIBLE (no ``trial_timeout`` can reap an in-parent hang).
+  ``isolate_stateful=True`` moves the whole stateful path (state store
+  included) into ONE dedicated spawned worker process: same sequential
+  semantics, same inheritance behavior, but the process boundary makes
+  the deadline enforceable — a hung trial is reaped as status=timeout
+  and the worker killed + respawned (its state store resets, so
+  successors inheriting from lost trials retrain from scratch — the
+  same fallback as inheriting from an unknown id).
 """
 
 from __future__ import annotations
@@ -104,6 +112,106 @@ def _eval_one(args):
     )
 
 
+def _stateful_eval(
+    workload: Workload,
+    states: "OrderedDict[int, Any]",
+    trained: dict,
+    max_states: int,
+    trial_id: int,
+    raw_params: dict,
+    budget: int,
+    seed: int,
+) -> TrialResult:
+    """One stateful evaluation against a (states, trained) store — the
+    SINGLE implementation behind both the in-parent path and the
+    ``isolate_stateful`` worker process, so warm-resume/inheritance
+    semantics cannot drift between them."""
+    t0 = time.perf_counter()
+    params = _clean(raw_params)
+    src = raw_params.get("__inherit_from__")
+    if src is not None and src in states:
+        state = states[src]
+        done = trained.get(src, 0)
+    elif trial_id in states:
+        state = states[trial_id]
+        done = trained[trial_id]
+    else:
+        state = workload.init_state(params, seed)
+        done = 0
+    remaining = max(0, budget - done)
+    try:
+        state, score = workload.train(state, params, remaining, seed)
+    except Exception as e:
+        # the failed member's state is NOT stored: a PBT successor
+        # inheriting from it would resume a half-trained wreck
+        return failed_result(
+            trial_id,
+            budget,
+            f"{type(e).__name__}: {e}",
+            wall_time=time.perf_counter() - t0,
+        )
+    if not math.isfinite(float(score)):
+        return failed_result(
+            trial_id,
+            budget,
+            f"non-finite score {float(score)!r}",
+            score=float(score),
+            wall_time=time.perf_counter() - t0,
+        )
+    states[trial_id] = state
+    states.move_to_end(trial_id)
+    trained[trial_id] = budget
+    while len(states) > max_states:
+        old, _ = states.popitem(last=False)
+        trained.pop(old, None)
+    return TrialResult(
+        trial_id=trial_id,
+        score=float(score),
+        step=budget,
+        wall_time=time.perf_counter() - t0,
+    )
+
+
+def _stateful_worker_main(conn, workload_name, workload_kwargs, seed, max_states):
+    """Entry point of the ``isolate_stateful`` worker (spawned child).
+
+    Owns the (states, trained) store for its lifetime; jobs arrive as
+    ``(trial_id, raw_params, budget)`` tuples and leave as TrialResults.
+    ``"reset"`` clears the store (Backend.reset), ``None`` exits. The
+    initial ``("ready", pid)`` handshake lets the parent exclude child
+    cold-start (spawn + jax import + platform pin) from any trial's
+    deadline."""
+    try:
+        _init_pool_worker(workload_name, workload_kwargs)
+    except BaseException as e:
+        try:
+            conn.send(("init_failed", f"{type(e).__name__}: {e}"))
+        finally:
+            return
+    states: "OrderedDict[int, Any]" = OrderedDict()
+    trained: dict = {}
+    conn.send(("ready", os.getpid()))
+    while True:
+        try:
+            msg = conn.recv()
+        except EOFError:
+            return
+        if msg is None:
+            return
+        if msg == "reset":
+            states.clear()
+            trained.clear()
+            conn.send("reset_ok")
+            continue
+        trial_id, raw_params, budget = msg
+        conn.send(
+            _stateful_eval(
+                _WORKER_WORKLOAD, states, trained, max_states,
+                trial_id, raw_params, budget, seed,
+            )
+        )
+
+
 @register_backend
 class CPUBackend(Backend):
     name = "cpu"
@@ -116,6 +224,7 @@ class CPUBackend(Backend):
         workload_kwargs: dict | None = None,
         max_states: int = 256,
         trial_timeout: float | None = None,  # seconds per trial, None = unbounded
+        isolate_stateful: bool = False,  # stateful path in a spawned worker
     ):
         super().__init__(workload)
         self.n_workers = n_workers or (os.cpu_count() or 1)
@@ -123,8 +232,11 @@ class CPUBackend(Backend):
         if trial_timeout is not None and trial_timeout <= 0:
             raise ValueError(f"trial_timeout must be > 0, got {trial_timeout}")
         self.trial_timeout = trial_timeout
+        self.isolate_stateful = bool(isolate_stateful)
         self._workload_kwargs = workload_kwargs or {}
         self._pool = None
+        self._stateful_proc = None
+        self._stateful_conn = None
         self._warned_stateful_platform = False
         self._warned_stateful_timeout = False
         # trial_id -> training state, FIFO-bounded: PBT mints fresh trial
@@ -153,6 +265,11 @@ class CPUBackend(Backend):
 
     def evaluate(self, trials: Sequence[Trial]) -> list[TrialResult]:
         if self.workload.stateful:
+            if self.isolate_stateful:
+                # the state store lives in a dedicated spawned worker:
+                # same sequential semantics as in-parent, but the
+                # process boundary makes trial_timeout enforceable
+                return [self._evaluate_stateful_isolated(t) for t in trials]
             # stateful path: warm resumes + PBT inheritance need the
             # state store, which lives in this process
             if self.trial_timeout is not None and not self._warned_stateful_timeout:
@@ -164,10 +281,12 @@ class CPUBackend(Backend):
 
                 warnings.warn(
                     "cpu backend: trial_timeout cannot be enforced for "
-                    "stateful workloads (they evaluate in-parent, and an "
+                    "stateful workloads evaluating in-parent (an "
                     "in-process call can't be interrupted) — exceptions "
                     "and non-finite scores are still caught, hangs are "
-                    "not reaped",
+                    "not reaped. Pass isolate_stateful=True "
+                    "(--isolate-stateful) to run the stateful path in a "
+                    "killable worker process",
                     stacklevel=3,
                 )
             return [self._evaluate_stateful(t) for t in trials]
@@ -300,65 +419,134 @@ class CPUBackend(Backend):
                 "on-device population training, or pin the parent to cpu",
                 stacklevel=3,
             )
-        t0 = time.perf_counter()
-        params = _clean(t.params)
-        src = t.params.get("__inherit_from__")
-        if src is not None and src in self._states:
-            state = self._states[src]
-            done = self._trained.get(src, 0)
-        elif t.trial_id in self._states:
-            state = self._states[t.trial_id]
-            done = self._trained[t.trial_id]
-        else:
-            state = self.workload.init_state(params, self.seed)
-            done = 0
-        remaining = max(0, t.budget - done)
+        return _stateful_eval(
+            self.workload, self._states, self._trained, self.max_states,
+            t.trial_id, t.params, t.budget, self.seed,
+        )
+
+    # -- process-isolated stateful evaluation (--isolate-stateful) ---------
+
+    def _ensure_stateful_worker(self) -> None:
+        """Spawn (or respawn) the dedicated stateful worker and wait for
+        its readiness handshake, so child cold-start (spawn + jax import
+        + platform pin, seconds of wall) is never billed to a trial's
+        deadline."""
+        if self._stateful_proc is not None and self._stateful_proc.is_alive():
+            return
+        self._kill_stateful_worker()
+        ctx = mp.get_context("spawn")
+        parent, child = ctx.Pipe()
+        proc = ctx.Process(
+            target=_stateful_worker_main,
+            args=(
+                child,
+                self.workload.name,
+                self._workload_kwargs,
+                self.seed,
+                self.max_states,
+            ),
+            daemon=True,
+        )
+        proc.start()
+        child.close()
+        self._stateful_proc, self._stateful_conn = proc, parent
+        # generous fixed window: this is process bring-up, not a trial
+        if not parent.poll(120.0):
+            self._kill_stateful_worker()
+            raise RuntimeError("stateful worker did not come up within 120s")
         try:
-            state, score = self.workload.train(state, params, remaining, self.seed)
-        except Exception as e:
-            # the failed member's state is NOT stored: a PBT successor
-            # inheriting from it would resume a half-trained wreck. No
-            # timeout is possible here (in-parent execution can't be
-            # interrupted) — that's the documented stateful-path limit.
-            return failed_result(
-                t.trial_id,
-                t.budget,
-                f"{type(e).__name__}: {e}",
-                wall_time=time.perf_counter() - t0,
-            )
-        if not math.isfinite(float(score)):
-            return failed_result(
-                t.trial_id,
-                t.budget,
-                f"non-finite score {float(score)!r}",
-                score=float(score),
-                wall_time=time.perf_counter() - t0,
-            )
-        self._states[t.trial_id] = state
-        self._states.move_to_end(t.trial_id)
-        self._trained[t.trial_id] = t.budget
-        while len(self._states) > self.max_states:
-            old, _ = self._states.popitem(last=False)
-            self._trained.pop(old, None)
-        return TrialResult(
-            trial_id=t.trial_id,
-            score=float(score),
-            step=t.budget,
-            wall_time=time.perf_counter() - t0,
+            msg = parent.recv()
+        except (EOFError, OSError) as e:
+            self._kill_stateful_worker()
+            raise RuntimeError(
+                f"stateful worker died during startup ({type(e).__name__})"
+            ) from None
+        if not (isinstance(msg, tuple) and msg[0] == "ready"):
+            self._kill_stateful_worker()
+            raise RuntimeError(f"stateful worker failed to initialize: {msg!r}")
+
+    def _kill_stateful_worker(self) -> None:
+        if self._stateful_proc is None:
+            return
+        proc, conn = self._stateful_proc, self._stateful_conn
+        self._stateful_proc = self._stateful_conn = None
+        if proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=2.0)
+            if proc.is_alive():  # ignored the TERM: it is truly wedged
+                proc.kill()
+        proc.join()
+        if conn is not None:
+            conn.close()
+
+    def _evaluate_stateful_isolated(self, t: Trial) -> TrialResult:
+        self._ensure_stateful_worker()
+        t0 = time.monotonic()
+        try:
+            self._stateful_conn.send((t.trial_id, t.params, t.budget))
+        except (BrokenPipeError, OSError):
+            # worker died between trials: one respawn, then evaluate
+            self._kill_stateful_worker()
+            self._ensure_stateful_worker()
+            self._stateful_conn.send((t.trial_id, t.params, t.budget))
+        if self._stateful_conn.poll(self.trial_timeout):
+            try:
+                return self._stateful_conn.recv()
+            except (EOFError, OSError):
+                # the worker died MID-trial (segfault/OOM-kill/os._exit):
+                # no result will ever arrive, and the state store died
+                # with it — successors inheriting lost states retrain
+                # from scratch (the standard unknown-id fallback)
+                self._kill_stateful_worker()
+                return failed_result(
+                    t.trial_id,
+                    t.budget,
+                    "stateful worker died mid-trial (state store reset; "
+                    "inheritors retrain from scratch)",
+                    wall_time=time.monotonic() - t0,
+                )
+        # deadline passed with the worker alive: the trial hung — the
+        # reap the in-parent path structurally cannot do (ROADMAP open
+        # item closed by process isolation)
+        self._kill_stateful_worker()
+        return failed_result(
+            t.trial_id,
+            t.budget,
+            f"no result within {self.trial_timeout}s (stateful trial "
+            "hung; worker killed, state store reset)",
+            status="timeout",
+            wall_time=time.monotonic() - t0,
         )
 
     def reset(self):
         """Drop the stateful-path state store (see Backend.reset): a new
         search's trial ids must not warm-resume the previous search's
-        states. The worker pool (process spawns) is kept."""
+        states. The worker pool (process spawns) is kept — and so is the
+        isolated stateful worker, whose store is cleared via message
+        (falling back to a kill if it doesn't answer)."""
         self._states.clear()
         self._trained.clear()
+        if self._stateful_proc is not None and self._stateful_proc.is_alive():
+            try:
+                self._stateful_conn.send("reset")
+                if self._stateful_conn.poll(10.0) and self._stateful_conn.recv() == "reset_ok":
+                    return
+            except (BrokenPipeError, EOFError, OSError):
+                pass
+            self._kill_stateful_worker()
 
     def close(self):
         if self._pool is not None:
             self._pool.terminate()
             self._pool.join()
             self._pool = None
+        if self._stateful_proc is not None:
+            try:
+                self._stateful_conn.send(None)  # clean exit request
+                self._stateful_proc.join(timeout=2.0)
+            except (BrokenPipeError, OSError):
+                pass
+            self._kill_stateful_worker()
 
 
 def _clean(params: dict) -> dict:

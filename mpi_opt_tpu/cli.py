@@ -18,6 +18,9 @@ import sys
 from mpi_opt_tpu.algorithms import ALGORITHMS, get_algorithm
 from mpi_opt_tpu.backends import available_backends, get_backend
 from mpi_opt_tpu.driver import run_search
+from mpi_opt_tpu.health import EX_TEMPFAIL, SweepInterrupted
+from mpi_opt_tpu.health import heartbeat as _heartbeat
+from mpi_opt_tpu.health import shutdown as _shutdown
 from mpi_opt_tpu.ops.pbt import PBTConfig
 from mpi_opt_tpu.utils.metrics import stdout_logger
 from mpi_opt_tpu.workloads import available, get_workload
@@ -245,8 +248,30 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="SPEC",
         help="fault-injection drill (driver path): wrap the workload in "
         "seeded chaos, e.g. 'exc=0.1,nan=0.05,hang=0.02,slow=0.1,seed=7' "
-        "(probabilities per fault; hang_s=/slow_s= tune durations). "
-        "Faults are a deterministic function of (seed, trial params)",
+        "(probabilities per fault; preempt= drills the graceful-shutdown "
+        "protocol; hang_s=/slow_s= tune durations). Faults are a "
+        "deterministic function of (seed, trial params)",
+    )
+    # rank health (health/): graceful preemption + hang detection
+    p.add_argument(
+        "--isolate-stateful",
+        action="store_true",
+        help="cpu backend: evaluate STATEFUL workloads (PBT inheritance, "
+        "ASHA warm resume) in a dedicated spawned worker holding the "
+        "state store, instead of in-parent — makes --trial-timeout "
+        "enforceable there (a hung trial is reaped as status=timeout "
+        "and the worker respawned; its state store resets, so "
+        "inheritors of lost states retrain from scratch)",
+    )
+    p.add_argument(
+        "--heartbeat-file",
+        default=None,
+        metavar="PATH",
+        help="write a monotonic progress beat (atomic JSON rewrite) to "
+        "this file at every completed batch/launch — the liveness "
+        "signal launch.py's --stall-timeout watchdog reads. The "
+        "supervisor wires this per rank automatically; set manually "
+        "for external watchdogs",
     )
     return p
 
@@ -454,6 +479,42 @@ def run_fused(args, parser, workload) -> int:
     n_chips = int(mesh.devices.size) if mesh is not None else 1
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
     t0 = time.perf_counter()
+    try:
+        return _run_fused_dispatch(args, parser, workload, mesh, n_chips, metrics, t0)
+    except SweepInterrupted as e:
+        # graceful preemption: the drained launch's snapshot is flushed
+        # (fused trainers force an off-cadence save before raising);
+        # exit EX_TEMPFAIL so a supervisor restarts with --resume
+        # without billing its --retries budget
+        metrics.count_preempted()
+        metrics.summary(final=True)
+        print(
+            json.dumps(
+                {
+                    "preempted": True,
+                    "signal": e.signal,
+                    "at": e.at,
+                    "workload": args.workload,
+                    "algorithm": args.algorithm,
+                    "backend": "fused",
+                }
+            )
+        )
+        print(
+            f"graceful shutdown ({e.signal}) at {e.at}: snapshot flushed; "
+            f"relaunch with --resume to continue (exit {EX_TEMPFAIL})",
+            file=sys.stderr,
+        )
+        return EX_TEMPFAIL
+
+
+def _run_fused_dispatch(args, parser, workload, mesh, n_chips, metrics, t0) -> int:
+    """The fused algorithm dispatch + summary (run_fused's tail, split
+    out so the graceful-shutdown catch wraps every fused path)."""
+    import time
+
+    from mpi_opt_tpu.utils.profiling import profile_window
+
     with profile_window(args.profile_dir):
         if args.algorithm == "pbt":
             from mpi_opt_tpu.train.fused_pbt import fused_pbt
@@ -614,6 +675,12 @@ def main(argv=None) -> int:
         )
     if args.trial_timeout is not None and args.trial_timeout <= 0:
         parser.error(f"--trial-timeout must be > 0, got {args.trial_timeout}")
+    if args.isolate_stateful and (args.fused or args.backend != "cpu"):
+        parser.error(
+            "--isolate-stateful moves the cpu backend's in-parent "
+            "stateful path into a worker process; fused/TPU sweeps "
+            "have no such path"
+        )
     if (args.ledger or args.warm_start) and args.fused:
         parser.error(
             "--ledger/--warm-start journal and replay per-trial driver "
@@ -683,6 +750,23 @@ def main(argv=None) -> int:
                 "rank, and note bring-up must happen before any other "
                 "JAX use in the process)"
             )
+    # everything from here RUNS the sweep: arm the graceful-shutdown
+    # protocol (SIGTERM/SIGINT set a drain flag; batch/launch boundaries
+    # flush and exit EX_TEMPFAIL) and the optional progress heartbeat.
+    # Both are scoped: handlers restored and heartbeat dropped on the
+    # way out, so in-process callers (tests, embedders) see no residue.
+    try:
+        with _shutdown.ShutdownGuard():
+            if args.heartbeat_file:
+                _heartbeat.configure(args.heartbeat_file)
+            return _run_sweep(args, parser)
+    finally:
+        _heartbeat.deconfigure()
+
+
+def _run_sweep(args, parser) -> int:
+    """The sweep body of ``main`` (split out so the shutdown guard and
+    heartbeat lifecycle wrap every path)."""
     workload = get_workload(args.workload)
     chaos_kwargs = None
     if args.chaos is not None:
@@ -711,6 +795,7 @@ def main(argv=None) -> int:
             "n_workers": args.workers,
             "seed": args.seed,
             "trial_timeout": args.trial_timeout,
+            "isolate_stateful": args.isolate_stateful,
         }
         if chaos_kwargs is not None:
             # pool workers rebuild the workload from (name, kwargs);
@@ -761,10 +846,22 @@ def main(argv=None) -> int:
     if args.ledger:
         from mpi_opt_tpu.ledger import LedgerError, SweepLedger
 
+        # rank-0-only journaling under multi-process SPMD: every rank
+        # runs the same deterministic driver loop and must replay the
+        # SHARED journal identically, but N ranks fsync-appending one
+        # file would interleave records and corrupt it — non-zero ranks
+        # open read-only (in-memory bookkeeping only)
+        ledger_rank = 0
+        if args.multihost or args.coordinator is not None:
+            import jax
+
+            ledger_rank = jax.process_index()
         try:
-            ledger = SweepLedger(args.ledger)
+            ledger = SweepLedger(args.ledger, read_only=ledger_rank != 0)
         except LedgerError as e:
             parser.error(f"--ledger: {e}")
+        if ledger.read_only:
+            metrics.log("ledger_rank_gated", rank=ledger_rank)
         if ledger.records and not args.resume:
             # explicit opt-in, same rule as --checkpoint-dir (ADVICE r2):
             # a stale journal must not silently replay an old sweep
@@ -833,6 +930,30 @@ def main(argv=None) -> int:
         print(json.dumps({"aborted": str(e)}))
         print(str(e), file=sys.stderr)
         return 1
+    except SweepInterrupted as e:
+        # graceful preemption: run_search drained at a batch boundary —
+        # every completed trial is journaled (ledger fsyncs per record)
+        # and an off-cadence checkpoint was forced. EX_TEMPFAIL tells
+        # the launch supervisor "restart me with --resume, for free"
+        metrics.count_preempted()
+        metrics.summary(final=True)
+        print(
+            json.dumps(
+                {
+                    "preempted": True,
+                    "signal": e.signal,
+                    "at": e.at,
+                    "trials_done": metrics.trials_done,
+                }
+            )
+        )
+        print(
+            f"graceful shutdown ({e.signal}): checkpoint + ledger "
+            f"flushed; relaunch with --resume to continue "
+            f"(exit {EX_TEMPFAIL})",
+            file=sys.stderr,
+        )
+        return EX_TEMPFAIL
     finally:
         backend.close()
         if checkpointer is not None:

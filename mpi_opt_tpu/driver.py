@@ -18,6 +18,8 @@ from typing import Optional, Sequence
 
 from mpi_opt_tpu.algorithms.base import Algorithm
 from mpi_opt_tpu.backends.base import Backend
+from mpi_opt_tpu.health import heartbeat, shutdown
+from mpi_opt_tpu.health.shutdown import SweepInterrupted
 from mpi_opt_tpu.ledger.store import result_from_record
 from mpi_opt_tpu.trial import Trial, TrialResult
 from mpi_opt_tpu.utils.metrics import MetricsLogger, null_logger
@@ -157,6 +159,11 @@ class _FailureTracker:
             self.failed += 1
             if r.status == "timeout":
                 self.timeout += 1
+                # a reaped deadline IS a detected stall: the evaluation
+                # wedged (or its worker died) and was killed — the
+                # trial-level twin of the supervisor's rank watchdog,
+                # and the producer behind the summary's stalls_detected
+                self.metrics.count_stalls()
             self.metrics.count_failure(r.status)
             self.metrics.log(
                 "trial_failed",
@@ -300,8 +307,32 @@ def run_search(
             best_score=None if best is None else round(best.score, 6),
         )
         batches += 1
+        saved = False
         if checkpointer is not None:
-            checkpointer.maybe_save(batches, algorithm, backend)
+            saved = checkpointer.maybe_save(batches, algorithm, backend)
+        # the rank's liveness pulse: one beat per completed batch (the
+        # launch supervisor's stall watchdog times the gaps between
+        # these). No-op unless the process configured --heartbeat-file.
+        heartbeat.beat(stage="driver", batches=batches, trials=algorithm.n_trials)
+        if shutdown.requested() and not algorithm.finished():
+            # graceful-shutdown drain point: the in-flight batch is done
+            # and journaled (the ledger fsyncs per record); force an
+            # off-cadence snapshot so --resume loses nothing, then hand
+            # the preemption up to the CLI's EX_TEMPFAIL exit. A batch
+            # that COMPLETED the sweep exits normally instead — same
+            # rule as the fused launch_boundary's final=True: finishing
+            # strictly dominates preempting a finished sweep
+            if checkpointer is not None and not saved:
+                checkpointer.save(batches, algorithm, backend)
+            metrics.log(
+                "preempt_drain",
+                signal=shutdown.active_signal(),
+                batches=batches,
+                trials=algorithm.n_trials,
+            )
+            raise SweepInterrupted(
+                shutdown.active_signal(), at=f"batch {batches}"
+            )
         if max_batches is not None and batches >= max_batches:
             break
     if replay and algorithm.finished():

@@ -1,0 +1,103 @@
+"""Per-rank progress heartbeat: the liveness signal exit codes can't give.
+
+A rank wedged in a collective (one peer dead, the rest blocked on ICI)
+or in dead I/O is ALIVE to ``waitpid`` — the supervisor's exit-code
+poll never fires, and the job runs forever. The heartbeat is the
+missing observable: each rank rewrites one small JSON file at every
+unit of real progress (driver batch, fused launch/rung/generation)
+carrying a MONOTONIC beat counter plus wall timestamp and progress
+fields. The supervisor's ``watchdog.StallDetector`` reads the files;
+beats frozen past ``--stall-timeout`` while the process lives = hang.
+
+Writes are write-tmp-then-rename so a reader never sees a torn record,
+and deliberately NOT fsync'd — the file signals liveness, not history;
+losing the last beat in a power cut costs nothing.
+
+Failure isolation: a heartbeat that cannot be written (dir vanished,
+disk full) must never kill the sweep it reports on — ``beat`` warns
+once and goes quiet.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+
+class Heartbeat:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self.beats = 0
+        self._warned = False
+
+    def beat(self, **progress) -> Optional[dict]:
+        """Record one unit of progress; returns the record (None if the
+        write failed — warned once, never raised)."""
+        self.beats += 1
+        rec = {
+            "pid": os.getpid(),
+            "beats": self.beats,
+            "ts": round(time.time(), 4),
+            "progress": progress,
+        }
+        tmp = f"{self.path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                f.write(json.dumps(rec))
+            os.replace(tmp, self.path)
+        except OSError as e:
+            if not self._warned:
+                self._warned = True
+                import warnings
+
+                warnings.warn(
+                    f"heartbeat write to {self.path} failed ({e}); liveness "
+                    "reporting disabled for this process — a stall watchdog "
+                    "watching this file will treat the rank as unwatched",
+                    stacklevel=2,
+                )
+            return None
+        return rec
+
+
+_ACTIVE: Optional[Heartbeat] = None
+
+
+def configure(path: str) -> Heartbeat:
+    """Install the process-wide heartbeat (the CLI's --heartbeat-file)."""
+    global _ACTIVE
+    _ACTIVE = Heartbeat(path)
+    return _ACTIVE
+
+
+def deconfigure() -> None:
+    """Drop the process-wide heartbeat (end of a CLI run: in-process
+    callers must not leave a stale path that later beats crash on)."""
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> Optional[Heartbeat]:
+    return _ACTIVE
+
+
+def beat(**progress) -> None:
+    """Module-level beat: no-op unless a heartbeat is configured, so
+    library code (driver, fused trainers) calls it unconditionally."""
+    if _ACTIVE is not None:
+        _ACTIVE.beat(**progress)
+
+
+def read_beat(path: str) -> Optional[dict]:
+    """The last complete beat record at ``path``, or None (missing,
+    unreadable, or torn — the rename discipline makes torn ~impossible,
+    but a reader must still never crash on a file it doesn't own)."""
+    try:
+        with open(path, "r") as f:
+            rec = json.loads(f.read())
+    except (OSError, json.JSONDecodeError):
+        return None
+    return rec if isinstance(rec, dict) else None

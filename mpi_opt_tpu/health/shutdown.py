@@ -1,0 +1,111 @@
+"""Graceful-shutdown signal protocol (preemption-safe sweeps).
+
+TPU/cloud platforms preempt workers with SIGTERM and only escalate to
+SIGKILL after a grace window. A sweep that treats SIGTERM as death
+loses the in-flight batch and makes the supervisor burn a retry on a
+non-failure; a sweep that ignores it gets SIGKILLed mid-checkpoint.
+The protocol here is the middle path:
+
+1. ``ShutdownGuard`` installs SIGTERM/SIGINT handlers that only SET A
+   FLAG — nothing is interrupted, no async-unsafe work happens in the
+   handler.
+2. Drain points (the driver's batch boundary, the fused trainers'
+   launch/rung/generation boundaries) poll ``requested()``: when set,
+   they finish the in-flight unit, flush durable state (checkpoint
+   snapshot, ledger records are already fsync'd), and raise
+   ``SweepInterrupted``.
+3. The CLI catches it and exits ``EX_TEMPFAIL`` (75, sysexits.h's
+   "temporary failure; retry"), the dedicated code ``launch.py``
+   classifies as PREEMPTION: coordinated restart with ``--resume``
+   that does NOT consume the ``--retries`` budget.
+
+A second SIGINT escalates to an immediate ``KeyboardInterrupt`` (the
+interactive convention: first Ctrl-C drains, second aborts). Repeated
+SIGTERM stays graceful on purpose — a supervisor forwarding SIGTERM to
+a process group whose members already received the platform's signal
+must not turn the drain into an abort.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Optional
+
+# sysexits.h EX_TEMPFAIL: "temporary failure, user is invited to retry".
+# The one exit code in the launch supervisor's contract that means
+# "restart me with --resume, and don't bill the retry budget".
+EX_TEMPFAIL = 75
+
+
+class SweepInterrupted(RuntimeError):
+    """Raised at a drain point after a graceful-shutdown request.
+
+    By construction the in-flight batch/launch has completed and durable
+    state (checkpoint snapshot, ledger journal) is flushed; the catcher
+    should summarize and exit ``EX_TEMPFAIL``.
+    """
+
+    def __init__(self, signal_name: Optional[str] = None, at: str = ""):
+        self.signal = signal_name or "SIGTERM"
+        self.at = at
+        super().__init__(
+            f"graceful shutdown ({self.signal})" + (f" at {at}" if at else "")
+        )
+
+
+_ACTIVE: Optional["ShutdownGuard"] = None
+
+
+class ShutdownGuard:
+    """Context manager owning the process's graceful-shutdown flag.
+
+    Installs the flag-setting handlers on enter (main thread only —
+    elsewhere the poll API still works, signal delivery is the host
+    application's concern) and restores the previous handlers on exit,
+    so in-process callers (tests, library embedders) never leak a
+    changed SIGINT disposition.
+    """
+
+    def __init__(self):
+        self.requested = False
+        self.signal_name: Optional[str] = None
+        self.installed = False
+        self._prev: dict = {}
+        self._outer: Optional[ShutdownGuard] = None
+
+    def _handle(self, signum, frame):
+        if self.requested and signum == signal.SIGINT:
+            # second Ctrl-C: the user wants out NOW, not after the batch
+            raise KeyboardInterrupt
+        self.requested = True
+        if self.signal_name is None:
+            self.signal_name = signal.Signals(signum).name
+
+    def __enter__(self) -> "ShutdownGuard":
+        global _ACTIVE
+        if threading.current_thread() is threading.main_thread():
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                self._prev[sig] = signal.signal(sig, self._handle)
+            self.installed = True
+        self._outer = _ACTIVE
+        _ACTIVE = self
+        return self
+
+    def __exit__(self, *exc):
+        global _ACTIVE
+        if self.installed:
+            for sig, prev in self._prev.items():
+                signal.signal(sig, prev)
+            self.installed = False
+        _ACTIVE = self._outer
+        return False
+
+
+def requested() -> bool:
+    """Is a graceful shutdown pending? (False when no guard is active.)"""
+    return _ACTIVE is not None and _ACTIVE.requested
+
+
+def active_signal() -> Optional[str]:
+    return None if _ACTIVE is None else _ACTIVE.signal_name

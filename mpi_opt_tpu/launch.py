@@ -14,16 +14,38 @@ be a COORDINATED job restart. This module is that coordination, and the
 It spawns ``--n-proc`` ranks of ``python -m mpi_opt_tpu`` (appending
 ``--coordinator/--num-processes/--process-id`` for each), watches them,
 and on ANY rank death kills the survivors and relaunches ALL ranks —
-with ``--resume`` appended when the job has a ``--checkpoint-dir``, so
-the restarted job continues from the last shared snapshot and (because
-fused-sweep resume is bit-identical, tested) finishes with the result
-the unkilled job would have produced. Without a checkpoint dir a
-restart re-runs the (deterministic) sweep from scratch.
+with ``--resume`` appended when the job has durable state
+(``--checkpoint-dir`` or ``--ledger``), so the restarted job continues
+from the last shared snapshot / journal and (because fused-sweep resume
+is bit-identical, tested) finishes with the result the unkilled job
+would have produced. Without durable state a restart re-runs the
+(deterministic) sweep from scratch.
 
-Transient-vs-program classification is deliberately NOT attempted here:
-a supervisor sees exit codes, not exception types. A program bug burns
-its retries quickly (each relaunch fails in seconds at the same point)
-and surfaces the rank's stderr; a platform death resumes and completes.
+Three failure classes, three treatments (README: failure-handling
+matrix):
+
+- RANK DEATH (nonzero exit, not 75): coordinated restart, consuming one
+  unit of the ``--retries`` budget. Transient-vs-program classification
+  is deliberately NOT attempted (a supervisor sees exit codes, not
+  exception types); a program bug burns its retries in seconds and
+  surfaces the rank's stderr, a platform death resumes and completes.
+- PREEMPTION (exit 75 = EX_TEMPFAIL, the graceful-shutdown protocol's
+  code; or SIGTERM delivered to the supervisor itself): not a failure.
+  A rank exiting 75 has drained and flushed; the supervisor restarts
+  with ``--resume`` WITHOUT consuming ``--retries`` (bounded by
+  ``--max-preemptions`` so a deterministic self-preempting bug cannot
+  restart forever). The supervisor being SIGTERMed forwards the signal
+  to all ranks, drains them for ``--term-grace`` seconds, then exits 75
+  itself — so nested supervision composes.
+- HANG (``--stall-timeout``): ranks are alive but their heartbeat files
+  (health/heartbeat.py, auto-wired via ``--heartbeat-file``) have
+  stopped advancing — a wedged collective or dead I/O that exit-code
+  polling can never see. The job is killed and coordinate-restarted,
+  consuming one retry.
+
+Escalation is always graceful-first: survivors/stragglers get SIGTERM
+(their own drain handlers flush state) and only after ``--term-grace``
+seconds SIGKILL.
 
 Per-rank stdout/stderr go to ``--log-dir`` (default: a temp dir,
 printed) as ``rank{i}.out``/``rank{i}.err``, truncated per attempt;
@@ -42,6 +64,9 @@ import subprocess
 import sys
 import tempfile
 import time
+
+from mpi_opt_tpu.health.shutdown import EX_TEMPFAIL, ShutdownGuard
+from mpi_opt_tpu.health.watchdog import StallDetector
 
 
 def _backoff_s(attempt: int, base: float, jitter: float, rng: random.Random) -> float:
@@ -62,9 +87,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _spawn_ranks(n: int, rest: list[str], log_dir: str):
+def _hb_path(log_dir: str, rank: int) -> str:
+    return os.path.join(log_dir, f"rank{rank}.hb")
+
+
+def _spawn_ranks(n: int, rest: list[str], log_dir: str, heartbeat: bool = False):
     """One attempt's rank processes; a fresh coordinator port each time
-    (the previous attempt's port may linger in TIME_WAIT)."""
+    (the previous attempt's port may linger in TIME_WAIT). With
+    ``heartbeat`` each rank gets ``--heartbeat-file`` pointed at its
+    per-rank file under ``log_dir`` (the stall watchdog's input)."""
     port = _free_port()
     procs = []
     for i in range(n):
@@ -80,6 +111,8 @@ def _spawn_ranks(n: int, rest: list[str], log_dir: str):
             "--process-id",
             str(i),
         ]
+        if heartbeat:
+            argv += ["--heartbeat-file", _hb_path(log_dir, i)]
         out = open(os.path.join(log_dir, f"rank{i}.out"), "w")
         err = open(os.path.join(log_dir, f"rank{i}.err"), "w")
         procs.append(
@@ -88,8 +121,20 @@ def _spawn_ranks(n: int, rest: list[str], log_dir: str):
     return procs
 
 
-def _kill_all(procs) -> None:
-    for p, out, err in procs:
+def _stop_all(procs, grace: float) -> None:
+    """Stop every live rank: SIGTERM first (a draining rank flushes its
+    checkpoint/ledger and exits 75 on its own), escalate to SIGKILL only
+    after ``grace`` seconds — a rank wedged mid-collective never answers
+    the TERM, and waiting on it forever recreates the hang this
+    supervisor exists to bound."""
+    for p, _, _ in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.monotonic() + max(0.0, grace)
+    for p, _, _ in procs:
+        while p.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+    for p, _, _ in procs:
         if p.poll() is None:
             p.kill()
     for p, out, err in procs:
@@ -98,24 +143,40 @@ def _kill_all(procs) -> None:
         err.close()
 
 
-def _watch(procs, poll_s: float):
-    """Block until every rank exits 0 (returns None) or any rank fails
-    (returns its index; survivors are killed — they are mid-collective
-    with a dead peer and will never finish on their own)."""
+def _watch(procs, poll_s: float, grace: float, detector=None, guard=None):
+    """Block until the job resolves; returns one of
+    ``("done", None)`` — every rank exited 0;
+    ``("exit", i)`` — rank i exited nonzero (survivors are stopped: they
+    are mid-collective with a dead peer and will never finish alone);
+    ``("stall", ranks)`` — ``detector`` saw those ranks' heartbeats
+    frozen past the stall timeout while the processes live;
+    ``("shutdown", signame)`` — the supervisor itself was asked to die
+    (``guard``), so the ranks are drained and the caller exits 75."""
     try:
         while True:
+            if guard is not None and guard.requested:
+                return ("shutdown", guard.signal_name)
             running = False
             for i, (p, _, _) in enumerate(procs):
                 rc = p.poll()
                 if rc is None:
                     running = True
                 elif rc != 0:
-                    return i
+                    return ("exit", i)
             if not running:
-                return None
+                return ("done", None)
+            if detector is not None:
+                # liveness filter: a rank that EXITED 0 leaves its last
+                # heartbeat frozen forever — that is teardown, not a
+                # stall, and must not get healthy survivors killed
+                stale = [
+                    i for i in detector.poll() if procs[i][0].poll() is None
+                ]
+                if stale:
+                    return ("stall", stale)
             time.sleep(poll_s)
     finally:
-        _kill_all(procs)
+        _stop_all(procs, grace)
 
 
 def main(argv=None) -> int:
@@ -129,8 +190,10 @@ def main(argv=None) -> int:
         "--retries",
         type=int,
         default=0,
-        help="coordinated full-job restarts after any rank death "
-        "(resumes from the last snapshot when the job checkpoints)",
+        help="coordinated full-job restarts after any rank death or "
+        "stall (resumes from the last snapshot when the job "
+        "checkpoints). Preemptions (rank exit 75) do NOT consume this "
+        "budget — see --max-preemptions",
     )
     parser.add_argument("--log-dir", default=None, help="per-rank stdout/stderr")
     parser.add_argument(
@@ -142,7 +205,40 @@ def main(argv=None) -> int:
         default=1.0,
         metavar="SECONDS",
         help="base delay before a coordinated restart; doubles per "
-        "attempt with up to 50%% random jitter (0 disables)",
+        "attempt with up to 50%% random jitter (0 disables). Preemption "
+        "restarts wait only the (jittered) base — they are not failures "
+        "and must not back off exponentially",
+    )
+    parser.add_argument(
+        "--stall-timeout",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="hang watchdog: kill + coordinated-restart the job when "
+        "any rank's heartbeat stops advancing for this long while the "
+        "process lives (wedged collective, dead I/O). Ranks are only "
+        "watched from their FIRST beat (first completed batch/launch), "
+        "so cold-start compilation is never timed; size the timeout "
+        "above the longest legitimate gap between launches. Wires "
+        "--heartbeat-file into every rank automatically",
+    )
+    parser.add_argument(
+        "--term-grace",
+        type=float,
+        default=15.0,
+        metavar="SECONDS",
+        help="how long stopped ranks get to drain after SIGTERM before "
+        "SIGKILL (graceful ranks flush checkpoint+ledger and exit 75 "
+        "within this window)",
+    )
+    parser.add_argument(
+        "--max-preemptions",
+        type=int,
+        default=16,
+        metavar="N",
+        help="bound on free preemption restarts (rank exit 75): a "
+        "deterministically self-preempting program must not restart "
+        "forever just because preemptions don't bill --retries",
     )
     parser.add_argument(
         "rest",
@@ -157,13 +253,29 @@ def main(argv=None) -> int:
         parser.error("pass the per-rank CLI arguments after '--'")
     if args.n_proc < 1:
         parser.error(f"--n-proc must be >= 1, got {args.n_proc}")
+    # bad values are usage errors (rc=2 + message), not ValueError
+    # tracebacks from the watchdog constructor deep in the launch loop
+    if args.stall_timeout is not None and args.stall_timeout <= 0:
+        parser.error(f"--stall-timeout must be > 0, got {args.stall_timeout}")
+    if args.max_preemptions < 0:
+        parser.error(
+            f"--max-preemptions must be >= 0, got {args.max_preemptions}"
+        )
+    if args.term_grace < 0:
+        parser.error(f"--term-grace must be >= 0, got {args.term_grace}")
     # argparse accepts both '--flag value' and '--flag=value'; match
     # flags by token prefix so the '=' spelling can't slip through the
     # ownership guard (or, below, defeat the --resume recovery append)
     def _has_flag(tokens, flag):
         return any(t == flag or t.startswith(flag + "=") for t in tokens)
 
-    for banned in ("--coordinator", "--num-processes", "--process-id", "--retries"):
+    for banned in (
+        "--coordinator",
+        "--num-processes",
+        "--process-id",
+        "--retries",
+        "--heartbeat-file",
+    ):
         if _has_flag(rest, banned):
             parser.error(
                 f"{banned} is owned by the supervisor; don't pass it in "
@@ -172,95 +284,196 @@ def main(argv=None) -> int:
     log_dir = args.log_dir or tempfile.mkdtemp(prefix="mpi_opt_tpu_launch_")
     os.makedirs(log_dir, exist_ok=True)
 
-    has_ckpt = _has_flag(rest, "--checkpoint-dir")
+    # --resume on restart is valid whenever the job has durable state to
+    # continue from: orbax snapshots (--checkpoint-dir) or the trial
+    # journal (--ledger); --resume on empty state starts fresh, which is
+    # also correct
+    has_resumable = _has_flag(rest, "--checkpoint-dir") or _has_flag(rest, "--ledger")
+    watch_stalls = args.stall_timeout is not None
     backoff_rng = random.Random(os.getpid())
-    attempt = 0
-    while True:
-        rank_args = list(rest)
-        if attempt > 0 and has_ckpt and "--resume" not in rank_args:
-            # the restarted job continues from the last shared snapshot;
-            # --resume on an empty dir (crash before the first save)
-            # starts fresh, which is also correct
-            rank_args.append("--resume")
-        print(
-            json.dumps(
-                {
-                    "event": "launch",
-                    "attempt": attempt,
-                    "n_proc": args.n_proc,
-                    "log_dir": log_dir,
-                    "resume": "--resume" in rank_args,
-                }
-            ),
-            flush=True,
-        )
-        procs = _spawn_ranks(args.n_proc, rank_args, log_dir)
-        failed = _watch(procs, args.poll_interval)
-        if failed is None:
-            # success: re-surface rank 0's summary line as our own
-            with open(os.path.join(log_dir, "rank0.out")) as f:
-                lines = [l for l in f.read().splitlines() if l.strip()]
-            if lines:
-                print(lines[-1], flush=True)
-            print(
-                json.dumps({"event": "done", "attempts": attempt + 1}), flush=True
+    attempt = 0  # failure restarts consumed (vs --retries)
+    preemptions = 0  # free restarts consumed (vs --max-preemptions)
+    stalls = 0
+    relaunches = 0
+
+    def _event(name, **fields):
+        print(json.dumps({"event": name, **fields}), flush=True)
+
+    with ShutdownGuard() as guard:
+        while True:
+            if guard.requested:
+                # preempted between attempts (e.g. during backoff sleep)
+                _event("preempted", signal=guard.signal_name)
+                return EX_TEMPFAIL
+            rank_args = list(rest)
+            if relaunches > 0 and has_resumable and "--resume" not in rank_args:
+                # the restarted job continues from the last shared
+                # snapshot / journal
+                rank_args.append("--resume")
+            _event(
+                "launch",
+                attempt=attempt,
+                n_proc=args.n_proc,
+                log_dir=log_dir,
+                resume="--resume" in rank_args,
             )
-            return 0
-        rc = procs[failed][0].returncode
-        with open(os.path.join(log_dir, f"rank{failed}.err")) as f:
-            tail = f.read()[-2000:]
-        if rc == 2:
-            # argparse usage error: deterministic, and retrying would be
-            # actively wrong — e.g. the CLI's stale-checkpoint-dir
-            # refusal (exit 2) would be "recovered" by the retry's
-            # --resume into silently replaying the old sweep, the exact
-            # accident that refusal exists to stop. Surface it instead.
-            print(
-                json.dumps(
-                    {"event": "failed", "rank": failed, "returncode": rc,
-                     "attempts": attempt + 1, "usage_error": True}
-                ),
-                flush=True,
+            detector = None
+            if watch_stalls:
+                # fresh detector AND fresh heartbeat files per attempt: a
+                # stale file from the previous attempt would put the new
+                # rank under watch while it is still compiling
+                for i in range(args.n_proc):
+                    try:
+                        os.unlink(_hb_path(log_dir, i))
+                    except FileNotFoundError:
+                        pass
+                detector = StallDetector(
+                    [_hb_path(log_dir, i) for i in range(args.n_proc)],
+                    args.stall_timeout,
+                )
+            procs = _spawn_ranks(args.n_proc, rank_args, log_dir, heartbeat=watch_stalls)
+            kind, info = _watch(
+                procs, args.poll_interval, args.term_grace, detector, guard
             )
-            sys.stderr.write(
-                f"rank {failed} rejected its arguments (rc=2); not "
-                f"retrying a usage error. Stderr:\n{tail}\n"
+            if kind == "done":
+                # success: re-surface rank 0's summary line as our own
+                with open(os.path.join(log_dir, "rank0.out")) as f:
+                    lines = [l for l in f.read().splitlines() if l.strip()]
+                if lines:
+                    print(lines[-1], flush=True)
+                _event(
+                    "done",
+                    attempts=attempt + 1,
+                    preemptions=preemptions,
+                    stalls_detected=stalls,
+                )
+                return 0
+            if kind == "shutdown":
+                # the supervisor itself was preempted: ranks were
+                # TERM-drained by _watch's finally; exit 75 so an OUTER
+                # supervisor (or the platform) treats this whole job as
+                # gracefully preempted too
+                _event("preempted", signal=info, preemptions=preemptions)
+                return EX_TEMPFAIL
+            if kind == "stall":
+                stalls += 1
+                _event(
+                    "stall",
+                    ranks=info,
+                    stall_timeout=args.stall_timeout,
+                    stalls_detected=stalls,
+                )
+                if attempt >= args.retries:
+                    _event(
+                        "failed",
+                        stalled_ranks=info,
+                        attempts=attempt + 1,
+                        stalls_detected=stalls,
+                    )
+                    sys.stderr.write(
+                        f"ranks {info} stalled (no heartbeat progress in "
+                        f"{args.stall_timeout}s); retries exhausted.\n"
+                    )
+                    return 1
+                attempt += 1
+                delay = _backoff_s(attempt, args.restart_backoff, 0.5, backoff_rng)
+                relaunches += 1
+                _event(
+                    "stall_restart",
+                    ranks=info,
+                    attempt=attempt,
+                    of=args.retries,
+                    backoff_s=round(delay, 3),
+                )
+                if delay > 0:
+                    time.sleep(delay)
+                continue
+            # kind == "exit": rank `info` left with a nonzero code
+            failed = info
+            rc = procs[failed][0].returncode
+            with open(os.path.join(log_dir, f"rank{failed}.err")) as f:
+                tail = f.read()[-2000:]
+            if rc == EX_TEMPFAIL:
+                # the graceful-shutdown protocol: the rank drained and
+                # flushed before exiting. A coordinated resume costs the
+                # platform nothing it hadn't already decided to spend —
+                # so it does NOT consume the failure --retries budget.
+                preemptions += 1
+                if preemptions > args.max_preemptions:
+                    _event(
+                        "failed",
+                        rank=failed,
+                        returncode=rc,
+                        preemptions=preemptions,
+                        preemption_budget_exhausted=True,
+                    )
+                    sys.stderr.write(
+                        f"rank {failed} exited 75 (preempted) "
+                        f"{preemptions} times, over --max-preemptions "
+                        f"{args.max_preemptions}; a program that preempts "
+                        "itself deterministically is a bug, not a "
+                        f"platform event. Stderr:\n{tail}\n"
+                    )
+                    return 1
+                # flat (jittered) base backoff: this is not a failure
+                # and must not walk up the exponential schedule
+                delay = _backoff_s(1, args.restart_backoff, 0.5, backoff_rng)
+                relaunches += 1
+                _event(
+                    "preempt_restart",
+                    rank=failed,
+                    preemptions=preemptions,
+                    of=args.max_preemptions,
+                    backoff_s=round(delay, 3),
+                )
+                if delay > 0:
+                    time.sleep(delay)
+                continue
+            if rc == 2:
+                # argparse usage error: deterministic, and retrying would be
+                # actively wrong — e.g. the CLI's stale-checkpoint-dir
+                # refusal (exit 2) would be "recovered" by the retry's
+                # --resume into silently replaying the old sweep, the exact
+                # accident that refusal exists to stop. Surface it instead.
+                _event(
+                    "failed",
+                    rank=failed,
+                    returncode=rc,
+                    attempts=attempt + 1,
+                    usage_error=True,
+                )
+                sys.stderr.write(
+                    f"rank {failed} rejected its arguments (rc=2); not "
+                    f"retrying a usage error. Stderr:\n{tail}\n"
+                )
+                return 1
+            if attempt >= args.retries:
+                _event(
+                    "failed",
+                    rank=failed,
+                    returncode=rc,
+                    attempts=attempt + 1,
+                    preemptions=preemptions,
+                    stalls_detected=stalls,
+                )
+                sys.stderr.write(
+                    f"rank {failed} died (rc={rc}); retries exhausted. "
+                    f"Last stderr:\n{tail}\n"
+                )
+                return 1
+            attempt += 1
+            delay = _backoff_s(attempt, args.restart_backoff, 0.5, backoff_rng)
+            relaunches += 1
+            _event(
+                "restart",
+                rank=failed,
+                returncode=rc,
+                attempt=attempt,
+                of=args.retries,
+                backoff_s=round(delay, 3),
             )
-            return 1
-        if attempt >= args.retries:
-            print(
-                json.dumps(
-                    {
-                        "event": "failed",
-                        "rank": failed,
-                        "returncode": rc,
-                        "attempts": attempt + 1,
-                    }
-                ),
-                flush=True,
-            )
-            sys.stderr.write(
-                f"rank {failed} died (rc={rc}); retries exhausted. "
-                f"Last stderr:\n{tail}\n"
-            )
-            return 1
-        attempt += 1
-        delay = _backoff_s(attempt, args.restart_backoff, 0.5, backoff_rng)
-        print(
-            json.dumps(
-                {
-                    "event": "restart",
-                    "rank": failed,
-                    "returncode": rc,
-                    "attempt": attempt,
-                    "of": args.retries,
-                    "backoff_s": round(delay, 3),
-                }
-            ),
-            flush=True,
-        )
-        if delay > 0:
-            time.sleep(delay)
+            if delay > 0:
+                time.sleep(delay)
 
 
 if __name__ == "__main__":

@@ -162,17 +162,31 @@ class SweepLedger:
     starts on a clean line boundary) and exposes the completed records
     for replay. ``ensure_header`` writes the header on a fresh file and
     verifies identity on an existing one.
+
+    ``read_only=True`` is the multi-process SPMD posture (rank-0-only
+    journaling): non-zero ranks run the same deterministic driver loop
+    over the SHARED journal — they must replay/verify it identically —
+    but N ranks fsync-appending one file would interleave records and
+    corrupt the stream, so only rank 0 writes. A read-only ledger keeps
+    the full in-memory view (header checks, ``completed()``,
+    ``record_trial`` bookkeeping) while never touching the file: no
+    append handle, no torn-tail truncation (rank 0 owns repairs), no
+    header/record writes.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, read_only: bool = False):
         self.path = os.path.abspath(path)
+        self.read_only = bool(read_only)
         self.header: Optional[dict] = None
         self.records: list[dict] = []
         self.n_torn = 0
         if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
             self.header, self.records, self.n_torn = read_ledger(self.path)
-            if self.n_torn:
+            if self.n_torn and not self.read_only:
                 self._truncate_torn_tail()
+        if self.read_only:
+            self._file = None
+            return
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         self._file = open(self.path, "a")
 
@@ -227,7 +241,8 @@ class SweepLedger:
             "config": dict(config),
             "created_ts": round(time.time(), 4),
         }
-        self._write_line(self.header)
+        if not self.read_only:
+            self._write_line(self.header)
 
     # -- append ------------------------------------------------------------
 
@@ -259,7 +274,10 @@ class SweepLedger:
             "cached": bool(cached),
             "ts": round(time.time(), 4),
         }
-        self._write_line(rec)
+        if not self.read_only:
+            self._write_line(rec)
+        # read-only ranks still track the record in memory: completed()
+        # and the dedup views must agree with rank 0's across the gang
         self.records.append(rec)
         return rec
 

@@ -81,6 +81,26 @@ def momentum_dtype_str() -> str:
     return resolve_momentum_dtype() or "float32"
 
 
+def launch_boundary(stage: str, *, final: bool, snapshot=None, **progress) -> None:
+    """The fused host loops' per-launch service point (one call at the
+    end of every launch/rung/generation): write the rank heartbeat, then
+    honor a pending graceful-shutdown request — flush the boundary
+    snapshot via ``snapshot()`` (pass None when the cadence save already
+    ran, or the sweep doesn't checkpoint) and raise ``SweepInterrupted``
+    so the CLI exits EX_TEMPFAIL and the launch supervisor restarts with
+    ``--resume`` for free. ``final=True`` (the sweep's last boundary)
+    suppresses the drain: completing normally strictly dominates
+    preempting a finished sweep."""
+    from mpi_opt_tpu.health import heartbeat, shutdown
+
+    heartbeat.beat(stage=stage, **progress)
+    if final or not shutdown.requested():
+        return
+    if snapshot is not None:
+        snapshot()
+    raise shutdown.SweepInterrupted(shutdown.active_signal(), at=stage)
+
+
 class HParamsFn:
     """Hashable (space, workload)-bound unit->OptHParams mapping, usable
     as a static jit argument (identity-hashed: space/workload come from

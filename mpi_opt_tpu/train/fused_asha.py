@@ -33,7 +33,12 @@ import jax
 import numpy as np
 
 from mpi_opt_tpu.ops.asha import asha_cut, asha_rungs
-from mpi_opt_tpu.train.common import finite_winner, momentum_dtype_str, workload_arrays
+from mpi_opt_tpu.train.common import (
+    finite_winner,
+    launch_boundary,
+    momentum_dtype_str,
+    workload_arrays,
+)
 
 
 @functools.partial(jax.jit, static_argnames=("trainer", "eta", "k"))
@@ -249,6 +254,16 @@ def fused_sha(
                         "rung_history": rung_history,
                     },
                 )
+            # heartbeat + graceful-shutdown drain: checkpointed sweeps
+            # already snapshot every rung (nothing extra to flush);
+            # uncheckpointed ones have no durable state — the drain
+            # still honors the preemption promptly
+            launch_boundary(
+                f"sha rung {r + 1}/{len(rungs)}",
+                final=r + 1 == len(rungs),
+                rung=r + 1,
+                of=len(rungs),
+            )
     finally:
         if snap is not None:
             snap.close()
@@ -406,7 +421,8 @@ def fused_hyperband(
         f"{getattr(workload, 'name', type(workload).__name__)}"
         f"|R={max_budget}|eta={eta}|seed={seed}"
     )
-    for b, (n, r) in enumerate(bracket_plan(max_budget, eta)):
+    plan = bracket_plan(max_budget, eta)
+    for b, (n, r) in enumerate(plan):
         if cohort_fn is None:
             cohort, n_model = None, None
         else:
@@ -443,6 +459,16 @@ def fused_hyperband(
         if cohort_fn is not None:
             summary["n_model_sampled"] = n_model
         brackets.append(summary)
+        # bracket boundary: each bracket's final rung suppresses the
+        # intra-sha drain (final=True there), so the between-bracket
+        # check here is what lets a preemption land between brackets —
+        # completed brackets replay instantly from their snapshots
+        launch_boundary(
+            f"hyperband bracket {b + 1}/{len(plan)}",
+            final=b + 1 == len(plan),
+            bracket=b + 1,
+            of=len(plan),
+        )
         # diverged brackets (non-finite best_score) never stick as the
         # overall winner — the ONE best-pick rule, shared with the host
         # path (see algorithms.base.best_finite); pairwise fold keeps

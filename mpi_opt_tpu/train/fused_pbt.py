@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from mpi_opt_tpu.ops.pbt import PBTConfig, pbt_exploit_explore
-from mpi_opt_tpu.train.common import finite_winner, momentum_dtype_str
+from mpi_opt_tpu.train.common import finite_winner, launch_boundary, momentum_dtype_str
 from mpi_opt_tpu.train.population import OptHParams, PopState, PopulationTrainer
 
 
@@ -406,9 +406,8 @@ def fused_pbt(
             launch_walls.append(time.perf_counter() - t_launch)
             is_last = i + 1 == n_launches
             due = (i + 1) % snapshot_every == 0
-            # save when a mid-sweep save comes due, or at the final
-            # launch when the caller wants the completed-sweep snapshot
-            if snap is not None and ((due and not is_last) or (is_last and snapshot_last)):
+
+            def save_now(i=i):
                 meta_extra = {
                     "launches_done": i + 1,
                     "best": [v.tolist() for v in best_parts],
@@ -425,6 +424,23 @@ def fused_pbt(
                 snap.save_population_sweep(
                     i + 1, state, unit, k_run, scores, meta_extra=meta_extra
                 )
+
+            # save when a mid-sweep save comes due, or at the final
+            # launch when the caller wants the completed-sweep snapshot
+            saved = False
+            if snap is not None and ((due and not is_last) or (is_last and snapshot_last)):
+                save_now()
+                saved = True
+            # heartbeat + graceful-shutdown drain: a preemption flushes
+            # an off-cadence snapshot (if checkpointing and the cadence
+            # save didn't just run) so --resume loses no launches
+            launch_boundary(
+                f"pbt launch {i + 1}/{n_launches}",
+                final=is_last,
+                snapshot=None if (snap is None or saved) else save_now,
+                launch=i + 1,
+                of=n_launches,
+            )
     finally:
         if snap is not None:
             snap.close()

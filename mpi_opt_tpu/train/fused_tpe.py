@@ -26,7 +26,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpi_opt_tpu.ops.tpe import TPEConfig, tpe_suggest
-from mpi_opt_tpu.train.common import finite_winner, momentum_dtype_str, workload_arrays
+from mpi_opt_tpu.train.common import (
+    finite_winner,
+    launch_boundary,
+    momentum_dtype_str,
+    workload_arrays,
+)
 
 
 @functools.partial(
@@ -245,6 +250,15 @@ def fused_tpe(
                         **({"member_fail": member_fail} if fails_complete else {}),
                     },
                 )
+            # heartbeat + graceful-shutdown drain: checkpointed sweeps
+            # snapshot every generation, so a preemption here resumes
+            # at exactly the next generation
+            launch_boundary(
+                f"tpe generation {g + 1}/{len(sizes)}",
+                final=g + 1 == len(sizes),
+                generation=g + 1,
+                of=len(sizes),
+            )
     finally:
         if snap is not None:
             snap.close()

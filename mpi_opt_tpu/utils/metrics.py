@@ -31,6 +31,15 @@ class MetricsLogger:
         # trials_done so throughput never counts un-run work
         self.cache_hits = 0
         self.replayed = 0
+        # health-layer counters (health/): preempted counts graceful-
+        # shutdown drains this process honored (0 or 1 per run — summed
+        # across restarts by log aggregation); stalls_detected counts
+        # wedged evaluations this process detected and killed (the
+        # driver feeds every reaped trial deadline into it — the
+        # trial-level twin of launch.py's rank watchdog, whose own
+        # kills appear in the supervisor's stall/done/failed events)
+        self.preempted = 0
+        self.stalls_detected = 0
 
     def log(self, event: str, **fields) -> dict:
         # `t` is relative (this process's clock, for intra-run deltas);
@@ -72,6 +81,14 @@ class MetricsLogger:
         """FINAL results served from the journal on replay-resume."""
         self.replayed += n
 
+    def count_preempted(self, n: int = 1):
+        """Graceful-shutdown drains honored (exit EX_TEMPFAIL follows)."""
+        self.preempted += n
+
+    def count_stalls(self, n: int = 1):
+        """Stalled (hung-but-alive) executions detected and killed."""
+        self.stalls_detected += n
+
     @property
     def wall(self) -> float:
         return time.perf_counter() - self.t_start
@@ -88,6 +105,8 @@ class MetricsLogger:
             trials_timeout=self.trials_timeout,
             cache_hits=self.cache_hits,
             replayed=self.replayed,
+            preempted=self.preempted,
+            stalls_detected=self.stalls_detected,
             wall_s=round(self.wall, 3),
             trials_per_sec_per_chip=round(self.trials_per_sec_per_chip(), 4),
             **extra,

@@ -16,6 +16,15 @@ production failure shapes at configured probabilities:
   stand-in) — its queued result never arrives, so this too is reaped
   by the per-trial timeout, and the backend recycles the pool
 - ``slow``: the evaluation takes extra wall time (straggler rank)
+- ``preempt``: delivers SIGTERM to the evaluating process itself
+  mid-evaluation — the platform-preemption stand-in that makes the
+  graceful-shutdown protocol (health/shutdown.py) fault-injectable.
+  Where evaluation runs in the DRIVER process (inline / in-parent
+  stateful paths) the installed handler turns it into a graceful
+  drain: the trial completes, the sweep flushes and exits
+  EX_TEMPFAIL (75). In a pool / isolated worker the signal simply
+  kills that worker (default disposition) — a crash-shaped outcome,
+  reaped like ``crash``.
 
 Determinism contract: whether a trial is faulted is a pure function of
 ``(chaos_seed, params)`` via a SHA-256 draw — stable across processes
@@ -38,6 +47,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import signal
 import time
 
 from mpi_opt_tpu.space import SearchSpace
@@ -57,7 +67,8 @@ def parse_chaos_spec(spec: str) -> dict:
     out: dict = {}
     numeric = {
         "exc": float, "nan": float, "hang": float, "crash": float,
-        "slow": float, "hang_s": float, "slow_s": float, "seed": int,
+        "slow": float, "preempt": float, "hang_s": float, "slow_s": float,
+        "seed": int,
     }
     for part in spec.split(","):
         part = part.strip()
@@ -75,7 +86,7 @@ def parse_chaos_spec(spec: str) -> dict:
                 f"unknown chaos key {k!r} (known: {sorted(numeric)})"
             )
         out[k] = numeric[k](v)
-    for p in ("exc", "nan", "hang", "crash", "slow"):
+    for p in ("exc", "nan", "hang", "crash", "slow", "preempt"):
         if not 0.0 <= out.get(p, 0.0) <= 1.0:
             raise ValueError(f"chaos probability {p}={out[p]} outside [0, 1]")
     return out
@@ -93,16 +104,17 @@ class ChaosWorkload(Workload):
         hang: float = 0.0,
         crash: float = 0.0,
         slow: float = 0.0,
+        preempt: float = 0.0,
         hang_s: float = 600.0,
         slow_s: float = 0.25,
         seed: int = 0,
         inner_kwargs: dict | None = None,
     ):
-        total = exc + nan + hang + crash + slow
+        total = exc + nan + hang + crash + slow + preempt
         if total > 1.0:
             raise ValueError(
                 f"chaos probabilities sum to {total} > 1 "
-                "(exc+nan+hang+crash+slow)"
+                "(exc+nan+hang+crash+slow+preempt)"
             )
         self.inner = get_workload(inner, **(inner_kwargs or {}))
         self.p_exc = exc
@@ -110,6 +122,7 @@ class ChaosWorkload(Workload):
         self.p_hang = hang
         self.p_crash = crash
         self.p_slow = slow
+        self.p_preempt = preempt
         self.hang_s = hang_s
         self.slow_s = slow_s
         self.chaos_seed = seed
@@ -136,12 +149,17 @@ class ChaosWorkload(Workload):
         h = hashlib.sha256(payload.encode()).digest()
         u = int.from_bytes(h[:8], "big") / 2**64  # uniform [0, 1)
         edge = 0.0
+        # preempt is LAST in the cascade on purpose: appending a new
+        # fault keeps every existing (seed, params) draw identical when
+        # its probability is 0, so the pinned counts in the determinism
+        # drills survive the addition
         for fault, p in (
             ("exc", self.p_exc),
             ("nan", self.p_nan),
             ("hang", self.p_hang),
             ("crash", self.p_crash),
             ("slow", self.p_slow),
+            ("preempt", self.p_preempt),
         ):
             edge += p
             if u < edge:
@@ -154,7 +172,15 @@ class ChaosWorkload(Workload):
             raise ChaosInjectedError(
                 f"chaos: injected trial failure (seed={self.chaos_seed})"
             )
-        if fault == "hang":
+        if fault == "preempt":
+            # the platform-preemption stand-in: SIGTERM to SELF. Under a
+            # ShutdownGuard (driver process) this only sets the drain
+            # flag and the evaluation CONTINUES — the trial completes,
+            # gets journaled, and the sweep drains at the batch
+            # boundary, so after a --resume the same trial replays
+            # instead of re-preempting (the restart loop converges).
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif fault == "hang":
             time.sleep(self.hang_s)
         elif fault == "crash":
             # the hard-death stand-in: no exception to catch, no result

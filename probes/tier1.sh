@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Tier-1 verify — the single entrypoint for CI and local gates.
+#
+# Exactly the ROADMAP.md tier-1 command: single-process (-p no:xdist),
+# chaos tests included, slow tests excluded, 870 s budget, with the
+# DOTS_PASSED count extracted from the progress lines (the driver's
+# no-worse-than-seed gate reads it).
+#
+# Usage: probes/tier1.sh            # run + report
+#        T1_LOG=/tmp/my.log probes/tier1.sh   # custom log path
+set -o pipefail
+cd "$(dirname "$0")/.."
+T1_LOG="${T1_LOG:-/tmp/_t1.log}"
+rm -f "$T1_LOG"
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$T1_LOG"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$T1_LOG" | tr -cd . | wc -c)"
+exit $rc

@@ -137,3 +137,73 @@ def test_stateful_trial_timeout_warns_unenforceable():
         (r,) = b.evaluate([t])
     b.close()
     assert r.ok
+
+
+# -- process-isolated stateful evaluation (--isolate-stateful) -------------
+
+
+def test_isolated_stateful_matches_in_parent_exactly():
+    """The isolated worker runs the SAME _stateful_eval over the same
+    store semantics: warm resume and PBT inheritance produce bit-equal
+    scores to the in-parent path (quadratic training is deterministic),
+    and no unenforceable-timeout warning fires (the deadline IS
+    enforceable now)."""
+    import warnings
+
+    wl = get_workload("quadratic")
+    space = wl.default_space()
+    params = {"lr": 0.5, "reg": 0.3}
+
+    def run(backend):
+        t = _trial(0, dict(params), 10, space)
+        r10 = backend.evaluate([t])[0]
+        t.budget = 30
+        r30 = backend.evaluate([t])[0]  # warm resume to 30
+        child = _trial(1, {**params, "__inherit_from__": 0}, 30, space)
+        rc = backend.evaluate([child])[0]  # PBT-style inheritance
+        return (r10.score, r30.score, rc.score)
+
+    b_in = CPUBackend(wl, n_workers=1)
+    b_iso = CPUBackend(wl, n_workers=1, isolate_stateful=True, trial_timeout=60.0)
+    try:
+        ref = run(b_in)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # no "unenforceable" warning
+            iso = run(b_iso)
+    finally:
+        b_in.close()
+        b_iso.close()
+    assert iso == ref
+
+
+def test_isolated_stateful_worker_death_fails_trial_and_respawns():
+    """A worker dying HARD mid-trial (chaos crash: os._exit) yields a
+    failed result immediately — no timeout needed, the pipe EOF is the
+    signal — and the NEXT trial transparently respawns a fresh worker
+    (state store reset: the documented cost of losing the process)."""
+    kw = {"inner": "quadratic", "crash": 0.5, "seed": 1}
+    wl = get_workload("chaos", **kw)
+    space = wl.default_space()
+    crash_p = clean_p = None
+    for i in range(200):
+        p = {"lr": 0.1 + i * 0.007, "reg": 0.3}
+        f = wl.fault_for(p)
+        if f == "crash" and crash_p is None:
+            crash_p = p
+        elif f is None and clean_p is None:
+            clean_p = p
+        if crash_p and clean_p:
+            break
+    assert crash_p and clean_p
+    b = CPUBackend(
+        wl, n_workers=1, isolate_stateful=True, trial_timeout=60.0,
+        workload_kwargs=kw,
+    )
+    try:
+        (r,) = b.evaluate([_trial(0, dict(crash_p), 10, space)])
+        assert not r.ok and r.status == "failed"
+        assert "died" in r.error
+        (r2,) = b.evaluate([_trial(1, dict(clean_p), 10, space)])
+        assert r2.ok  # fresh worker, clean trial
+    finally:
+        b.close()

@@ -51,12 +51,15 @@ def test_parse_chaos_spec():
         "exc": 0.1, "nan": 0.05, "seed": 7,
     }
     assert parse_chaos_spec("hang=1.0,hang_s=30") == {"hang": 1.0, "hang_s": 30.0}
+    assert parse_chaos_spec("preempt=0.2,seed=3") == {"preempt": 0.2, "seed": 3}
     with pytest.raises(ValueError, match="unknown chaos key"):
         parse_chaos_spec("explode=0.5")
     with pytest.raises(ValueError, match="key=value"):
         parse_chaos_spec("exc")
     with pytest.raises(ValueError, match="outside"):
         parse_chaos_spec("exc=1.5")
+    with pytest.raises(ValueError, match="outside"):
+        parse_chaos_spec("preempt=-0.1")
 
 
 def test_chaos_probabilities_must_sum_to_one_or_less():
@@ -206,3 +209,91 @@ def test_timeout_spares_innocent_trials_in_the_batch():
             assert r.status == "timeout"
         else:
             assert r.ok and 0.0 <= r.score <= 1.0
+
+
+# -- the preemption + stateful-hang drills (health/ + --isolate-stateful) --
+
+
+def test_preempt_fault_is_graceful_on_in_parent_paths():
+    """chaos ``preempt`` SIGTERMs the evaluating process itself. Where
+    evaluation runs in the DRIVER process (the stateful in-parent path
+    here), an installed ShutdownGuard absorbs it: the trial COMPLETES
+    with its real score and only the drain flag is raised — the
+    graceful-shutdown protocol, not a crash."""
+    from mpi_opt_tpu.health import ShutdownGuard
+    from mpi_opt_tpu.health import shutdown as shutdown_mod
+
+    wl = get_workload("chaos", inner="quadratic", preempt=1.0)
+    algo = RandomSearch(wl.default_space(), seed=0, max_trials=1, budget=10)
+    b = CPUBackend(wl, n_workers=1)
+    try:
+        with ShutdownGuard() as g:
+            (r,) = b.evaluate(algo.next_batch(1))
+            assert r.ok and math.isfinite(r.score)  # the trial finished
+            assert g.requested and g.signal_name == "SIGTERM"
+        assert not shutdown_mod.requested()  # scoped: nothing leaks
+    finally:
+        b.close()
+
+
+def test_preempt_draw_deterministic_and_appended_last():
+    """preempt joins the cascade LAST: with preempt=0 every existing
+    (seed, params) draw is unchanged (the pinned counts in the
+    determinism drills depend on this), and with it on, the draw is a
+    pure function of (chaos_seed, params) like every other fault."""
+    base = get_workload("chaos", **CHAOS)
+    plus = get_workload("chaos", **{**CHAOS, "preempt": 0.0})
+    params = [{"lr": 0.1 * i + 0.01, "reg": 0.4} for i in range(40)]
+    assert [base.fault_for(p) for p in params] == [plus.fault_for(p) for p in params]
+    pre = get_workload("chaos", inner="quadratic", preempt=0.3, seed=5)
+    draws = [pre.fault_for(p) for p in params]
+    assert "preempt" in draws
+    assert [pre.fault_for(p) for p in params] == draws  # stable
+
+
+def test_timeout_reap_counts_as_stall_detected():
+    """Every reaped trial deadline feeds the summary's stalls_detected
+    counter (the trial-level stall producer; supervisor-level rank
+    stalls are counted in launch.py's own events)."""
+    from mpi_opt_tpu.driver import run_search
+
+    kw = {"inner": "digits", "hang": 1.0, "hang_s": 120.0}
+    wl = get_workload("chaos", **kw)
+    algo = RandomSearch(wl.default_space(), seed=0, max_trials=1, budget=20)
+    b = CPUBackend(wl, n_workers=1, trial_timeout=1.5, workload_kwargs=kw)
+    m = MetricsLogger()
+    try:
+        run_search(algo, b, metrics=m)
+    finally:
+        b.close()
+    s = m.summary()
+    assert s["trials_timeout"] == 1
+    assert s["stalls_detected"] == 1
+
+
+def test_injected_hang_on_stateful_path_times_out_under_isolation():
+    """The acceptance criterion that closes the ROADMAP open item: a
+    chaos ``hang`` on a STATEFUL workload — in-parent, this blocks
+    forever by construction — terminates as status=timeout within ~2x
+    --trial-timeout under --isolate-stateful, because the state store
+    now lives in a killable worker process."""
+    import time
+
+    kw = {"inner": "quadratic", "hang": 1.0, "hang_s": 120.0}
+    wl = get_workload("chaos", **kw)
+    assert wl.stateful  # quadratic is stateful: the in-parent path
+    b = CPUBackend(
+        wl, n_workers=1, trial_timeout=1.5, isolate_stateful=True,
+        workload_kwargs=kw,
+    )
+    algo = RandomSearch(wl.default_space(), seed=0, max_trials=1, budget=10)
+    try:
+        (r,) = b.evaluate(algo.next_batch(1))
+    finally:
+        b.close()
+    assert r.status == "timeout"
+    assert math.isnan(r.score)
+    assert "hung" in r.error
+    # wall_time excludes worker bring-up (the ready handshake): the
+    # reap itself lands within ~2x the deadline
+    assert r.wall_time < 2 * 1.5

@@ -772,3 +772,110 @@ def test_cli_validates_failure_policy_flags(capsys):
             main(["--workload", "quadratic", "--trials", "2", *argv])
         assert exc.value.code == 2
         assert msg in capsys.readouterr().err
+
+
+# -- graceful shutdown (health/): exit 75, flushed state, free resume ------
+
+
+def test_cli_isolate_stateful_rejected_off_the_cpu_path(capsys):
+    with pytest.raises(SystemExit) as exc:
+        main([
+            "--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+            "--population", "4", "--generations", "1",
+            "--isolate-stateful",
+        ])
+    assert exc.value.code == 2
+    assert "--isolate-stateful" in capsys.readouterr().err
+
+
+@pytest.mark.chaos
+def test_cli_preempt_drill_exits_75_with_flushed_ledger_then_resumes(capsys, tmp_path):
+    """The acceptance drill, in-process: a chaos ``preempt`` SIGTERM
+    mid-sweep yields a flushed ledger and exit code 75; the re-run with
+    --resume replays the journaled trials and finishes with the clean
+    run's best. Chaos seed 7 puts the single preempt draw at trial
+    index 6 of this 12-trial seed-0 stream (so the drain journals 7
+    trials)."""
+    clean_args = [
+        "--workload", "quadratic", "--algorithm", "random",
+        "--trials", "12", "--budget", "10", "--workers", "1", "--seed", "0",
+    ]
+    assert main(clean_args) == 0
+    clean = _summary(capsys)
+
+    led = str(tmp_path / "sweep.jsonl")
+    drill = clean_args + ["--ledger", led, "--chaos", "preempt=0.15,seed=7"]
+    rc = main(drill)
+    out = capsys.readouterr().out
+    assert rc == 75
+    pre = [
+        json.loads(l) for l in out.splitlines()
+        if l.startswith("{") and '"preempted": true' in l and '"event"' not in l
+    ][-1]
+    assert pre["signal"] == "SIGTERM" and pre["trials_done"] == 7
+    # the metrics summary event carries the preempted counter
+    sev = [json.loads(l) for l in out.splitlines() if '"event": "summary"' in l][-1]
+    assert sev["preempted"] == 1
+    # the journal was fsync-flushed BEFORE exit: header + 7 trials
+    lines = open(led).read().splitlines()
+    assert len(lines) == 8
+    assert json.loads(lines[0])["kind"] == "header"
+
+    # resume: replay the 7, run the remaining 5, match the clean best
+    assert main(drill + ["--resume"]) == 0
+    resumed = _summary(capsys)
+    assert resumed["replayed"] == 7
+    assert resumed["n_trials"] == 12
+    assert resumed["best_score"] == pytest.approx(clean["best_score"], abs=1e-12)
+
+
+def test_fused_preempt_drains_snapshot_and_exits_75(capsys, tmp_path, monkeypatch):
+    """Fused sweeps drain at launch boundaries too: with a shutdown
+    pending, the first launch completes, its snapshot is flushed, and
+    the CLI exits 75; the --resume re-run finishes the sweep from that
+    snapshot. The drain flag is stubbed (not a real signal) so the test
+    is deterministic about WHERE the preemption lands."""
+    from mpi_opt_tpu.health import shutdown as shutdown_mod
+
+    ck = str(tmp_path / "ck")
+    argv = [
+        "--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+        "--population", "4", "--generations", "2",
+        "--steps-per-generation", "2", "--gen-chunk", "1", "--no-mesh",
+        "--seed", "0", "--checkpoint-dir", ck,
+    ]
+    monkeypatch.setattr(shutdown_mod, "requested", lambda: True)
+    monkeypatch.setattr(shutdown_mod, "active_signal", lambda: "SIGTERM")
+    rc = main(argv)
+    out = capsys.readouterr().out
+    assert rc == 75
+    pre = [
+        json.loads(l) for l in out.splitlines()
+        if l.startswith("{") and '"preempted": true' in l
+    ][-1]
+    assert pre["backend"] == "fused" and "launch 1/2" in pre["at"]
+    monkeypatch.undo()  # signals back to normal: the resume must finish
+    assert main(argv + ["--resume"]) == 0
+    resumed = _summary(capsys)
+    assert len(resumed["best_curve"]) == 2  # both generations present
+    assert 0.0 <= resumed["best_score"] <= 1.0
+
+
+def test_cli_heartbeat_file_beats_per_batch(tmp_path, capsys):
+    """--heartbeat-file: the driver writes one monotonic beat per
+    completed batch — the liveness signal launch.py's stall watchdog
+    consumes — and the configuration never leaks past main()."""
+    from mpi_opt_tpu.health import heartbeat, read_beat
+
+    hb = str(tmp_path / "rank0.hb")
+    rc = main([
+        "--workload", "quadratic", "--algorithm", "random",
+        "--trials", "4", "--budget", "10", "--workers", "1", "--seed", "0",
+        "--heartbeat-file", hb,
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    rec = read_beat(hb)
+    assert rec is not None and rec["beats"] == 4  # one per batch
+    assert rec["progress"]["stage"] == "driver"
+    assert heartbeat.active() is None  # deconfigured on the way out

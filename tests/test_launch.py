@@ -272,7 +272,7 @@ def test_supervisor_backs_off_between_restarts(tmp_path, monkeypatch):
     sleeps = []
     monkeypatch.setattr(launch.time, "sleep", lambda s: sleeps.append(s))
 
-    def fake_spawn(n, rest, log_dir):
+    def fake_spawn(n, rest, log_dir, heartbeat=False):
         procs = []
         for i in range(n):
             out = open(os.path.join(log_dir, f"rank{i}.out"), "w")
@@ -298,3 +298,242 @@ def test_supervisor_backs_off_between_restarts(tmp_path, monkeypatch):
     assert len(backoffs) == 2
     assert 8.0 <= backoffs[0] <= 12.0  # attempt 1: base * [1, 1.5)
     assert 16.0 <= backoffs[1] <= 24.0  # attempt 2: doubled
+
+
+# -- preemption / hang robustness (health/: graceful shutdown + watchdog) --
+
+
+# a fake rank that writes 3 heartbeat file updates then wedges forever
+# while staying alive — the hung-collective shape exit-code polling can
+# never see (tests drive it through launch.main's stall watchdog)
+_BEAT_THEN_FREEZE = """
+import json, os, sys, time
+p = sys.argv[1]
+for b in range(1, 4):
+    tmp = p + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps({"pid": os.getpid(), "beats": b, "ts": time.time(), "progress": {}}))
+    os.replace(tmp, p)
+    time.sleep(0.2)
+time.sleep(300)
+"""
+
+
+def _fake_spawn_script(script, argv_of=lambda log_dir, i: []):
+    def fake_spawn(n, rest, log_dir, heartbeat=False):
+        procs = []
+        for i in range(n):
+            out = open(os.path.join(log_dir, f"rank{i}.out"), "w")
+            err = open(os.path.join(log_dir, f"rank{i}.err"), "w")
+            p = subprocess.Popen(
+                [sys.executable, "-c", script, *argv_of(log_dir, i)],
+                stdout=out, stderr=err,
+            )
+            procs.append((p, out, err))
+        return procs
+
+    return fake_spawn
+
+
+def test_supervisor_stall_watchdog_kills_and_restarts(tmp_path, monkeypatch, capsys):
+    """A rank that beats then freezes (alive, no progress) is detected
+    within --stall-timeout of its last beat, killed, and coordinated-
+    restarted — consuming the --retries budget like any failure. Both
+    attempts stall here, so the run exhausts its one retry and fails
+    with the stall visible in the events."""
+    monkeypatch.setattr(
+        launch,
+        "_spawn_ranks",
+        _fake_spawn_script(
+            _BEAT_THEN_FREEZE,
+            argv_of=lambda log_dir, i: [os.path.join(log_dir, f"rank{i}.hb")],
+        ),
+    )
+    t0 = time.monotonic()
+    rc = launch.main([
+        "--n-proc", "1",
+        "--retries", "1",
+        "--stall-timeout", "1.5",
+        "--poll-interval", "0.1",
+        "--term-grace", "1",
+        "--restart-backoff", "0.1",
+        "--log-dir", str(tmp_path),
+        "--", "--workload", "quadratic",
+    ])
+    wall = time.monotonic() - t0
+    assert rc == 1
+    events = [json.loads(l) for l in capsys.readouterr().out.splitlines() if '"event"' in l]
+    names = [e["event"] for e in events]
+    assert names.count("stall") == 2  # one per attempt
+    assert "stall_restart" in names  # the coordinated restart happened
+    assert events[-1]["event"] == "failed"
+    assert events[-1]["stalls_detected"] == 2
+    # each stall resolved within ~(beats 0.6s + stall-timeout 1.5s +
+    # poll/kill slack); 2 attempts must fit well under the frozen ranks'
+    # own 300s sleep — the watchdog, not process exit, ended them
+    assert wall < 30
+
+
+def test_supervisor_sigterm_drains_ranks_and_exits_75(tmp_path, monkeypatch):
+    """SIGTERM to the supervisor forwards to the ranks (TERM, then KILL
+    after --term-grace) and exits EX_TEMPFAIL itself, so nested
+    supervision classifies the whole job as preempted, not failed."""
+    import threading
+
+    spawned = []
+    inner = _fake_spawn_script("import time; time.sleep(300)")
+
+    def recording_spawn(n, rest, log_dir, heartbeat=False):
+        procs = inner(n, rest, log_dir, heartbeat)
+        spawned.extend(p for p, _, _ in procs)
+        return procs
+
+    monkeypatch.setattr(launch, "_spawn_ranks", recording_spawn)
+    timer = threading.Timer(0.6, lambda: os.kill(os.getpid(), signal.SIGTERM))
+    timer.start()
+    try:
+        t0 = time.monotonic()
+        rc = launch.main([
+            "--n-proc", "1",
+            "--retries", "3",
+            "--poll-interval", "0.1",
+            "--term-grace", "2",
+            "--log-dir", str(tmp_path),
+            "--", "--workload", "quadratic",
+        ])
+        wall = time.monotonic() - t0
+    finally:
+        timer.cancel()
+    assert rc == 75
+    assert wall < 30  # drained, not waited out
+    assert spawned and all(p.poll() is not None for p in spawned)
+
+
+def test_supervisor_preemption_restart_does_not_consume_retries(tmp_path):
+    """The acceptance drill, end to end through real subprocesses: a
+    chaos ``preempt`` SIGTERMs the rank mid-sweep; the rank drains
+    (flushed ledger, exit 75); the supervisor — with --retries 0 —
+    still restarts it with --resume (preemptions are free), the resumed
+    rank replays the journal and completes. Chaos seed 7 puts the one
+    preempt draw at trial index 6 of the 12-trial seed-0 stream, so the
+    resumed run replays exactly 7 trials."""
+    led = str(tmp_path / "sweep.jsonl")
+    rc, out, err = _run_supervisor(
+        1,
+        0,  # zero retries: only the preemption protocol can restart this
+        ["--workload", "quadratic", "--algorithm", "random",
+         "--trials", "12", "--budget", "10", "--workers", "1",
+         "--seed", "0", "--ledger", led,
+         "--chaos", "preempt=0.15,seed=7",
+         "--platform", "cpu", "--no-mesh"],
+        str(tmp_path / "logs"),
+        timeout=300,
+    )
+    assert rc == 0, f"{out}\n{err}"
+    events = [json.loads(l) for l in out.splitlines() if '"event"' in l]
+    names = [e["event"] for e in events]
+    assert "preempt_restart" in names
+    assert "restart" not in names  # the failure path never engaged
+    done = events[-1]
+    assert done["event"] == "done" and done["preemptions"] == 1
+    launches = [e for e in events if e["event"] == "launch"]
+    assert [l["resume"] for l in launches] == [False, True]
+    s = _summary_line(out)
+    assert s["n_trials"] == 12
+    assert s["replayed"] == 7  # the drained run's journaled trials
+
+
+def test_supervisor_bounds_deterministic_self_preemption(tmp_path, monkeypatch, capsys):
+    """Exit 75 restarts are free but FINITE: a program that preempts
+    itself deterministically hits --max-preemptions and fails instead
+    of restarting forever."""
+    monkeypatch.setattr(
+        launch, "_spawn_ranks", _fake_spawn_script("raise SystemExit(75)")
+    )
+    monkeypatch.setattr(launch.time, "sleep", lambda s: None)
+    rc = launch.main([
+        "--n-proc", "1",
+        "--retries", "5",
+        "--max-preemptions", "2",
+        "--poll-interval", "0.01",
+        "--term-grace", "0.1",
+        "--log-dir", str(tmp_path),
+        "--", "--workload", "quadratic",
+    ])
+    assert rc == 1
+    events = [json.loads(l) for l in capsys.readouterr().out.splitlines() if '"event"' in l]
+    assert [e["event"] for e in events].count("preempt_restart") == 2
+    last = events[-1]
+    assert last["event"] == "failed" and last.get("preemption_budget_exhausted")
+
+
+def test_supervisor_owns_heartbeat_flag(capsys):
+    with pytest.raises(SystemExit):
+        launch.main(["--n-proc", "1", "--", "--heartbeat-file", "/tmp/x"])
+    assert "--heartbeat-file is owned by the supervisor" in capsys.readouterr().err
+
+
+def test_supervisor_validates_health_flags(capsys):
+    """Bad watchdog values are usage errors (rc=2 + message), not raw
+    ValueError tracebacks from the StallDetector constructor mid-loop."""
+    for argv, msg in (
+        (["--stall-timeout", "0"], "--stall-timeout must be > 0"),
+        (["--max-preemptions", "-1"], "--max-preemptions must be >= 0"),
+        (["--term-grace", "-1"], "--term-grace must be >= 0"),
+    ):
+        with pytest.raises(SystemExit) as exc:
+            launch.main(["--n-proc", "1", *argv, "--", "--workload", "quadratic"])
+        assert exc.value.code == 2
+        assert msg in capsys.readouterr().err
+
+
+# fake rank: write N beats at a fixed period, then exit 0
+_BEAT_THEN_EXIT = """
+import json, os, sys, time
+p, n, period = sys.argv[1], int(sys.argv[2]), float(sys.argv[3])
+for b in range(1, n + 1):
+    tmp = p + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps({"pid": os.getpid(), "beats": b, "ts": time.time(), "progress": {}}))
+    os.replace(tmp, p)
+    time.sleep(period)
+"""
+
+
+def test_stall_watchdog_ignores_ranks_that_exited_cleanly(tmp_path, monkeypatch, capsys):
+    """A rank that EXITED 0 leaves its last heartbeat frozen forever —
+    that is teardown, not a stall. The watchdog's liveness filter must
+    not let it get the still-working survivor killed (staggered finishes
+    are normal: uneven final launches)."""
+
+    def fake_spawn(n, rest, log_dir, heartbeat=False):
+        procs = []
+        for i in range(n):
+            out = open(os.path.join(log_dir, f"rank{i}.out"), "w")
+            err = open(os.path.join(log_dir, f"rank{i}.err"), "w")
+            hb = os.path.join(log_dir, f"rank{i}.hb")
+            # rank 0: keeps beating for ~3s; rank 1: one beat, exits fast
+            beats, period = (("20", "0.15") if i == 0 else ("1", "0.0"))
+            p = subprocess.Popen(
+                [sys.executable, "-c", _BEAT_THEN_EXIT, hb, beats, period],
+                stdout=out, stderr=err,
+            )
+            procs.append((p, out, err))
+        return procs
+
+    monkeypatch.setattr(launch, "_spawn_ranks", fake_spawn)
+    rc = launch.main([
+        "--n-proc", "2",
+        "--retries", "0",
+        "--stall-timeout", "1.0",  # << rank 0's remaining 3s of work
+        "--poll-interval", "0.1",
+        "--term-grace", "1",
+        "--log-dir", str(tmp_path),
+        "--", "--workload", "quadratic",
+    ])
+    assert rc == 0  # no false stall kill, no retry burned
+    events = [json.loads(l) for l in capsys.readouterr().out.splitlines() if '"event"' in l]
+    assert [e["event"] for e in events if e["event"] == "stall"] == []
+    assert events[-1] == {
+        "event": "done", "attempts": 1, "preemptions": 0, "stalls_detected": 0,
+    }

@@ -453,3 +453,48 @@ def test_base_algorithm_default_ingests_nothing():
     space = get_workload("quadratic").default_space()
     algo = PBT(space, seed=0, population=4, generations=2, steps_per_generation=1)
     assert algo.ingest_observations([Observation(np.zeros(2, np.float32), 1.0)]) == 0
+
+
+# -- rank-0-only journaling (multi-process SPMD; read-only ledgers) --------
+
+
+def test_read_only_ledger_never_touches_the_file(tmp_path):
+    """Non-zero SPMD ranks open the SHARED journal read-only: full
+    in-memory bookkeeping (header verification, completed() replay,
+    record_trial views stay rank-identical) with zero file writes — N
+    ranks fsync-appending one journal would interleave records and
+    corrupt the stream."""
+    path = str(tmp_path / "sweep.jsonl")
+    led = SweepLedger(path)
+    led.ensure_header({"algorithm": "random", "seed": 0, "space_hash": "x"})
+    led.record_trial(TrialResult(trial_id=0, score=1.0, step=5), {"lr": 1.0})
+    led.close()
+    before = open(path).read()
+
+    ro = SweepLedger(path, read_only=True)
+    assert ro.read_only
+    ro.ensure_header({"algorithm": "random", "seed": 0, "space_hash": "x"})
+    assert 0 in ro.completed()  # replay view works
+    rec = ro.record_trial(TrialResult(trial_id=1, score=2.0, step=5), {"lr": 2.0})
+    assert rec["trial_id"] == 1 and 1 in ro.completed()  # in-memory only
+    ro.close()
+    assert open(path).read() == before  # not a byte written
+
+    # config drift is refused on read-only ranks too (parity with rank 0)
+    ro2 = SweepLedger(path, read_only=True)
+    with pytest.raises(LedgerError, match="different sweep"):
+        ro2.ensure_header({"algorithm": "tpe", "seed": 0, "space_hash": "x"})
+    ro2.close()
+
+
+def test_read_only_ledger_fresh_path_creates_nothing(tmp_path):
+    """A non-zero rank starting a FRESH sweep must not create the file
+    either — rank 0 owns the header; the rank keeps an in-memory header
+    so its own bookkeeping (record_trial) still functions."""
+    path = str(tmp_path / "fresh.jsonl")
+    ro = SweepLedger(path, read_only=True)
+    ro.ensure_header({"algorithm": "random", "seed": 0, "space_hash": "x"})
+    ro.record_trial(TrialResult(trial_id=0, score=1.0, step=5), {"lr": 1.0})
+    assert ro.completed() == {0: ro.records[0]}
+    ro.close()
+    assert not os.path.exists(path)

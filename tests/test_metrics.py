@@ -96,6 +96,19 @@ def test_summary_includes_failure_counters():
     assert (z["trials_failed"], z["trials_retried"], z["trials_timeout"]) == (0, 0, 0)
 
 
+def test_summary_includes_health_counters():
+    """preempted / stalls_detected (health layer) reach the summary
+    record operators alarm on — explicit zeros when nothing happened."""
+    m = MetricsLogger()
+    m.count_preempted()
+    m.count_stalls(2)
+    s = m.summary()
+    assert s["preempted"] == 1
+    assert s["stalls_detected"] == 2
+    z = MetricsLogger().summary()
+    assert (z["preempted"], z["stalls_detected"]) == (0, 0)
+
+
 def test_null_logger_log_path_is_sink_free(monkeypatch):
     """null_logger() must stay zero-cost on the hot path: with no file
     and no stream, log() must not serialize (the driver logs per-batch
