@@ -128,6 +128,19 @@ def build_parser() -> argparse.ArgumentParser:
         "exceeds the platform's execution window; deterministic, "
         "checkpoint-guarded, not bit-identical to unchunked)",
     )
+    p.add_argument(
+        "--wave-size",
+        default="0",
+        metavar="N|auto",
+        help="fused pbt: population > device residency — train resident "
+        "waves of N members per generation, staging cold members' "
+        "params+momentum on host between waves (double-buffered async "
+        "transfers overlap wave compute); exploit/explore still runs "
+        "over the FULL population. 'auto' sizes the wave from a "
+        "residency estimate; 0 disables (fully resident). Bit-identical "
+        "to resident mode on the CPU backend (tested); see README "
+        "'Wave scheduling'",
+    )
     # multi-host bring-up (SURVEY.md §2 row 1 + §5): the reference's
     # ``mpirun`` launch WAS its user surface; the CLI owns SPMD bring-up
     # the same way — one OS process per host, each invoking this CLI
@@ -385,6 +398,27 @@ def make_algorithm(args, space):
     raise AssertionError(args.algorithm)
 
 
+def _finite_or_null(obj):
+    """Summary-layer JSON hygiene: ``json.dumps`` emits bare ``NaN`` /
+    ``Infinity`` tokens for non-finite floats — invalid JSON per the
+    spec, breaking the documented single-JSON-line contract for strict
+    (non-Python) parsers. An all-diverged fused sweep produces exactly
+    that: best_score NaN, and NaN entries in the curves (a generation
+    whose every member diverged has ``scores.max() == NaN``). Replace
+    non-finite floats with None recursively HERE, at the serialization
+    boundary — the result dicts keep their NaNs so library callers can
+    still detect divergence numerically."""
+    import math
+
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _finite_or_null(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_finite_or_null(v) for v in obj]
+    return obj
+
+
 def _has_snapshot(directory) -> bool:
     """Does an orbax sweep snapshot already live under ``directory``?
 
@@ -530,11 +564,22 @@ def _run_fused_dispatch(args, parser, workload, mesh, n_chips, metrics, t0) -> i
                 member_chunk=args.member_chunk,
                 gen_chunk=args.gen_chunk,
                 step_chunk=args.step_chunk,
+                wave_size=args.wave_size,
                 checkpoint_dir=args.checkpoint_dir,
                 snapshot_every=args.checkpoint_every,
             ), args.retries, metrics)
             n_trials = args.population * args.generations
             extra = {"best_curve": [round(float(v), 4) for v in res["best_curve"]]}
+            if res.get("wave_size"):
+                # wave-scheduling observability: the staging traffic and
+                # how much of it the double buffer hid behind compute
+                extra.update(
+                    wave_size=res["wave_size"],
+                    n_waves=res["n_waves"],
+                    staged_bytes=res["staged_bytes"],
+                    stage_overlap_s=round(res["stage_overlap_s"], 3),
+                    stage_wait_s=round(res["stage_wait_s"], 3),
+                )
         elif args.algorithm in ("asha", "random"):
             from mpi_opt_tpu.train.fused_asha import fused_sha
 
@@ -640,13 +685,17 @@ def _run_fused_dispatch(args, parser, workload, mesh, n_chips, metrics, t0) -> i
         else {k: v for k, v in res["best_params"].items() if not k.startswith("__")},
         **extra,
     }
+    # staging traffic (wave-scheduled sweeps): feed the counters BEFORE
+    # the summary so staged_bytes/stage_overlap_s appear in it
+    if res.get("staged_bytes") is not None:
+        metrics.count_staging(res["staged_bytes"], res.get("stage_overlap_s", 0.0))
     metrics.summary(
         final=True,
         member_failures=(
             None if member_failures is None else int(sum(member_failures))
         ),
     )
-    print(json.dumps(summary))
+    print(json.dumps(_finite_or_null(summary)))
     return 0
 
 
@@ -675,6 +724,29 @@ def main(argv=None) -> int:
         )
     if args.trial_timeout is not None and args.trial_timeout <= 0:
         parser.error(f"--trial-timeout must be > 0, got {args.trial_timeout}")
+    # --wave-size: parse + validate as a usage error (exit 2), not a
+    # ValueError traceback from fused_pbt deep in the run
+    if args.wave_size != "auto":
+        try:
+            args.wave_size = int(args.wave_size)
+        except ValueError:
+            parser.error(
+                f"--wave-size must be an integer or 'auto', got {args.wave_size!r}"
+            )
+        if args.wave_size < 0:
+            parser.error(f"--wave-size must be >= 0, got {args.wave_size}")
+    if args.wave_size:
+        if not args.fused or args.algorithm != "pbt":
+            parser.error(
+                "--wave-size schedules a fused PBT population through "
+                "host-staged waves; it requires --fused --algorithm pbt"
+            )
+        if args.gen_chunk > 1 or args.step_chunk > 0:
+            parser.error(
+                "--wave-size schedules whole generations as resident "
+                "waves; combining it with --gen-chunk/--step-chunk "
+                "launch splitting is ambiguous"
+            )
     if args.isolate_stateful and (args.fused or args.backend != "cpu"):
         parser.error(
             "--isolate-stateful moves the cpu backend's in-parent "
@@ -979,7 +1051,7 @@ def _run_sweep(args, parser) -> int:
         else {k: v for k, v in best.params.items() if not k.startswith("__")},
     }
     metrics.summary(**{"final": True})
-    print(json.dumps(summary))
+    print(json.dumps(_finite_or_null(summary)))
     return 0
 
 

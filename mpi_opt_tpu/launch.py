@@ -98,27 +98,70 @@ def _spawn_ranks(n: int, rest: list[str], log_dir: str, heartbeat: bool = False)
     per-rank file under ``log_dir`` (the stall watchdog's input)."""
     port = _free_port()
     procs = []
-    for i in range(n):
-        argv = [
-            sys.executable,
-            "-m",
-            "mpi_opt_tpu",
-            *rest,
-            "--coordinator",
-            f"127.0.0.1:{port}",
-            "--num-processes",
-            str(n),
-            "--process-id",
-            str(i),
-        ]
-        if heartbeat:
-            argv += ["--heartbeat-file", _hb_path(log_dir, i)]
-        out = open(os.path.join(log_dir, f"rank{i}.out"), "w")
-        err = open(os.path.join(log_dir, f"rank{i}.err"), "w")
-        procs.append(
-            (subprocess.Popen(argv, stdout=out, stderr=err, text=True), out, err)
-        )
+    # incremental build + cleanup-on-failure: if Popen dies mid-loop
+    # (fork EAGAIN, interpreter gone), the already-spawned ranks would
+    # otherwise leak as orphans wedged in jax.distributed bring-up
+    # waiting for peers that will never start — and their log handles
+    # with them. Kill and close everything spawned so far, then re-raise.
+    try:
+        for i in range(n):
+            argv = [
+                sys.executable,
+                "-m",
+                "mpi_opt_tpu",
+                *rest,
+                "--coordinator",
+                f"127.0.0.1:{port}",
+                "--num-processes",
+                str(n),
+                "--process-id",
+                str(i),
+            ]
+            if heartbeat:
+                argv += ["--heartbeat-file", _hb_path(log_dir, i)]
+            out = open(os.path.join(log_dir, f"rank{i}.out"), "w")
+            err = open(os.path.join(log_dir, f"rank{i}.err"), "w")
+            try:
+                procs.append(
+                    (subprocess.Popen(argv, stdout=out, stderr=err, text=True), out, err)
+                )
+            except BaseException:
+                # this rank's handles are not in procs yet
+                out.close()
+                err.close()
+                raise
+    except BaseException:
+        for p, out, err in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait()
+            out.close()
+            err.close()
+        raise
     return procs
+
+
+def _find_summary_line(text: str):
+    """The LAST line of a rank's stdout that has the summary-JSON shape:
+    a JSON object that is not a metrics event (``stdout_logger`` also
+    prints ``{"event": ...}`` records to stdout). Blindly re-printing
+    the last line broke the single-JSON-line contract whenever trailing
+    non-summary output followed the summary (a stray library print, a
+    late metrics flush); scanning for the shape keeps the relay correct
+    regardless of what lands after it. Returns None when no line
+    qualifies (the caller then falls back to the raw last line so a
+    rank whose output format drifted still surfaces SOMETHING)."""
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and "event" not in obj:
+            return line
+    return None
 
 
 def _stop_all(procs, grace: float) -> None:
@@ -337,10 +380,16 @@ def main(argv=None) -> int:
             )
             if kind == "done":
                 # success: re-surface rank 0's summary line as our own
+                # (scan for the summary-JSON shape — trailing
+                # non-summary output must not break the relay)
                 with open(os.path.join(log_dir, "rank0.out")) as f:
-                    lines = [l for l in f.read().splitlines() if l.strip()]
-                if lines:
-                    print(lines[-1], flush=True)
+                    text = f.read()
+                line = _find_summary_line(text)
+                if line is None:
+                    lines = [l for l in text.splitlines() if l.strip()]
+                    line = lines[-1] if lines else None
+                if line is not None:
+                    print(line, flush=True)
                 _event(
                     "done",
                     attempts=attempt + 1,

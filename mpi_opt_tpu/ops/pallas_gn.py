@@ -152,6 +152,15 @@ def _block_rows(b: int, s: int, c: int, elems: int = 1 << 19) -> int:
 def _forward(x, gamma, beta, groups, eps, relu):
     xf = _flatten(x)
     b, s, c = xf.shape
+    if c % groups:
+        # _group_matrices floor-divides (gs = c // groups): a
+        # non-dividing group count would build a wrong membership
+        # matrix and silently normalize over the wrong channels —
+        # refuse exactly where flax.linen.GroupNorm does
+        raise ValueError(
+            f"number of channels ({c}) must be divisible by num_groups "
+            f"({groups})"
+        )
     bb = _block_rows(b, s, c)
     g2 = gamma.reshape(1, c)
     b2 = beta.reshape(1, c)

@@ -134,6 +134,463 @@ def finish_generation(
     return state, new_u, scores.max(), scores.mean(), n_fail, scores[src_idx]
 
 
+@functools.partial(jax.jit, static_argnames=("discrete_mask", "cfg"))
+def _wave_exploit(
+    key: jax.Array,
+    unit: jax.Array,  # float32[P, d] — the FULL population's hparams
+    scores: jax.Array,  # float32[P] — all waves' pre-exploit scores
+    discrete_mask: tuple = (),
+    cfg: PBTConfig = PBTConfig(),
+):
+    """Generation-boundary decision for the wave-scheduled path: exactly
+    the tail of ``run_fused_pbt.one_generation`` minus the eval (already
+    done per wave) and minus the device gather — the winner-weight copy
+    is realized LAZILY by the next generation's stage-in indexing the
+    host pool with ``src_idx`` (train/staging.py), so exploit over a
+    host-staged population still operates on full-population scores.
+    Returns (new_unit, src_idx, best, mean, n_fail, post_scores)."""
+    disc = jnp.asarray(discrete_mask, dtype=bool)
+    new_u, src_idx, _ = pbt_exploit_explore(key, unit, scores, disc, cfg)
+    n_fail = jnp.sum(~jnp.isfinite(scores)).astype(jnp.int32)
+    return new_u, src_idx, scores.max(), scores.mean(), n_fail, scores[src_idx]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("trainer", "hparams_fn", "steps", "n_total"),
+    donate_argnames=("state",),
+)
+def _wave_train_program(
+    trainer, state, unit_slice, hparams_fn, train_x, train_y, key, steps, n_total, offset
+):
+    """One wave's training launch, with the unit->hparams mapping
+    applied IN-program. Applying it eagerly instead looks harmless but
+    is not: eager op-by-op kernels and fused XLA codegen disagree by
+    ~1e-7 relative on the log-uniform transforms, and the augmentation's
+    DISCRETE decisions (rounded shift offsets, bernoulli flips) amplify
+    an ulp of hparam difference into entirely different batches —
+    measured as 1e-2 param divergence within 4 steps. In-program hp is
+    what makes wave mode reproduce the resident scan bit-for-bit."""
+    hp = hparams_fn(unit_slice)
+    return type(trainer)._train_segment_window(
+        trainer, state, hp, train_x, train_y, key, steps, n_total, offset
+    )
+
+
+def _run_wave(
+    trainer,
+    pool,
+    rows,
+    offset: int,
+    unit,
+    hparams_fn,
+    train_x,
+    train_y,
+    val_x,
+    val_y,
+    k_train,
+    steps: int,
+    population: int,
+    mesh,
+    engine,
+    init_keys=None,
+    sample_x=None,
+):
+    """Stage in + train + eval ONE wave: members [offset, offset+W) of
+    the population. ``rows`` is the host-pool row index array and
+    already carries the previous generation's exploit source map, so
+    staging in IS the winner gather. Generation 0 passes ``init_keys``
+    instead (members don't exist yet — initializing on device skips a
+    pointless host round trip; the keys are the same
+    ``split(k_init, P)`` window the resident program would use, so the
+    weights are bit-identical). Module-level so crash-injection tests
+    can intercept it, like ``run_fused_pbt``."""
+    from mpi_opt_tpu.train.staging import stage_in, tree_bytes
+
+    w = len(rows)
+    if init_keys is not None:
+        st = trainer.init_members(init_keys, sample_x)
+        if mesh is not None:
+            from mpi_opt_tpu.parallel.mesh import shard_popstate
+
+            st = shard_popstate(st, mesh)
+    else:
+        dev = stage_in(pool, rows, mesh)
+        engine.note_bytes(tree_bytes(dev))
+        st = PopState(params=dev["params"], momentum=dev["momentum"], step=dev["step"])
+    st, _ = _wave_train_program(
+        trainer,
+        st,
+        unit[offset : offset + w],
+        hparams_fn,
+        train_x,
+        train_y,
+        k_train,
+        steps,
+        population,
+        jnp.int32(offset),
+    )
+    scores = trainer.eval_population(st, val_x, val_y)
+    return st, scores
+
+
+def _writable(tree):
+    """Orbax restores may hand back read-only numpy arrays; the pools
+    are written in place per wave, so copy only the leaves that need it."""
+    import numpy as np
+
+    return jax.tree.map(
+        lambda l: l if isinstance(l, np.ndarray) and l.flags.writeable else np.array(l),
+        tree,
+    )
+
+
+def _fused_pbt_waves(
+    workload,
+    trainer,
+    space,
+    train_x,
+    train_y,
+    val_x,
+    val_y,
+    population: int,
+    generations: int,
+    steps_per_gen: int,
+    seed: int,
+    cfg: PBTConfig,
+    mesh,
+    member_chunk: int,
+    wave_size: int,
+    checkpoint_dir,
+    snapshot_every: int,
+    snapshot_last: bool,
+):
+    """Wave-scheduled fused PBT: ``population > residency``.
+
+    Each generation trains ``ceil(P/W)`` resident waves of ~``W``
+    members in sequence through the SAME compiled per-wave program
+    (balanced split: at most two distinct wave sizes, so at most two
+    compiles), staging cold members' params+momentum on host between
+    waves, while exploit/explore at the generation boundary operates
+    over the FULL population: scores are gathered across waves,
+    truncation selection and perturbation run on all P members at once
+    (``_wave_exploit``), and winners' weights reach the next
+    generation's waves through the stage-in permutation.
+
+    Semantics: bit-identical to resident mode for ANY wave size on the
+    CPU backend (tested) — batch RNG is shared population-wide, member
+    RNG windows the full split (``train_segment_window``), init keys
+    slice the same ``split(k_init, P)``, and the exploit op sees the
+    same (key, unit, scores) triple. On accelerators where different
+    compiled shapes change float rounding this weakens to
+    documented-equivalent, the ``step_chunk`` standard.
+
+    Overlap: stage-out of wave k (device→host through this container's
+    ~15 MB/s tunnel) runs on ``StagingEngine``'s background thread
+    while the main thread dispatches wave k+1's stage-in + compute; the
+    only hard barrier is ``drain()`` at the generation boundary, where
+    the full score vector is needed. Device residency: at most two
+    waves (one computing, one being fetched).
+
+    Snapshots: generation-boundary on the ``snapshot_every`` cadence
+    (post-exploit pool + perm + unit + key), plus BETWEEN-WAVES
+    snapshots flushed by the graceful-shutdown drain at any wave
+    boundary (front+back pools, partial scores, pre-generation key) —
+    a preempted sweep resumes mid-generation without re-training
+    completed waves.
+    """
+    import time
+
+    import numpy as np
+
+    from mpi_opt_tpu.parallel.mesh import fetch_global, place_pop
+    from mpi_opt_tpu.train.common import HParamsFn
+    from mpi_opt_tpu.train.staging import StagingEngine, population_pool, write_rows
+    from mpi_opt_tpu.utils.checkpoint import SweepCheckpointer
+
+    wave_lens = _balanced_split(population, wave_size)
+    n_waves = len(wave_lens)
+    offs = [0]
+    for w in wave_lens[:-1]:
+        offs.append(offs[-1] + w)
+    disc = tuple(bool(b) for b in space.discrete_mask())
+    hparams_fn = HParamsFn(space, workload)
+    key = jax.random.key(seed)
+    k_init, k_unit, k_run = jax.random.split(key, 3)
+    # the SAME per-member init keys the resident program derives inside
+    # init_population — gen-0 waves slice windows of this split
+    member_keys = jax.random.split(k_init, population)
+
+    best_list: list = []
+    mean_list: list = []
+    fail_list: list = []
+    gen_walls: list = []
+    start_gen = 0
+    start_wave = 0
+    scores_host = np.full((population,), np.nan, np.float32)
+    post_scores = None
+    pool_front = pool_back = None
+    perm = None
+    unit = None
+    k_gen = None
+
+    snap = None
+    restored = None
+    if checkpoint_dir is not None:
+        import dataclasses
+
+        snap = SweepCheckpointer(
+            checkpoint_dir,
+            {
+                "workload": getattr(workload, "name", type(workload).__name__),
+                "population": population,
+                "generations": generations,
+                "steps_per_gen": steps_per_gen,
+                "seed": seed,
+                "member_chunk": member_chunk,
+                "cfg": dataclasses.asdict(cfg),
+                "momentum_dtype": momentum_dtype_str(),
+                # the wave split is part of the sweep's identity: the
+                # snapshot payload is pool+perm shaped by it, and a
+                # resident run must not silently resume a wave snapshot
+                "wave_size": wave_size,
+                "wave_lens": list(wave_lens),
+            },
+        )
+        restored = snap.restore_wave_sweep()
+        if restored is not None:
+            sweep, meta = restored
+            best_list = [float(v) for v in meta["best"]]
+            mean_list = [float(v) for v in meta["mean"]]
+            fail_list = [int(v) for v in meta["member_fail"]]
+            gen_walls = [float(v) for v in meta["gen_walls"]]
+            start_gen = int(meta["gen"])
+            start_wave = int(meta["waves_done"])
+            pool_front = _writable(sweep["front"])
+            perm = np.asarray(sweep["perm"])
+            unit = jnp.asarray(sweep["unit"])
+            restored_key = jax.random.wrap_key_data(jnp.asarray(sweep["key_data"]))
+            if start_wave:
+                # mid-generation: the saved key is the PRE-generation
+                # carried key (k_train/k_pbt re-derive from it)
+                k_gen = restored_key
+                pool_back = _writable(sweep["back"])
+                scores_host = np.array(sweep["scores"], np.float32)
+            else:
+                k_run = restored_key
+                post_scores = np.asarray(sweep["scores"])
+    if restored is None:
+        unit = space.sample_unit(k_unit, population)
+        perm = np.arange(population)
+        # the cold population's host residence; gen 0 fills it by
+        # stage-out (members init on device per wave)
+        pool_front = population_pool(trainer, train_x[:2], population)
+    if pool_back is None:
+        pool_back = population_pool(trainer, train_x[:2], population)
+    if mesh is not None:
+        unit = place_pop(unit, mesh)
+
+    snapshot_every = max(1, snapshot_every)
+    engine = StagingEngine()
+
+    def _writer(off):
+        def on_host(host):
+            write_rows(pool_back, off, host["state"])
+            w = len(host["scores"])
+            scores_host[off : off + w] = np.asarray(host["scores"], np.float32)
+
+        return on_host
+
+    try:
+        for g in range(start_gen, generations):
+            t_gen = time.perf_counter()
+            resumed_mid = g == start_gen and start_wave > 0
+            gen_partial0 = 0.0
+            if resumed_mid:
+                # the interrupted generation's pre-crash elapsed time,
+                # so its launch wall stays the launch's real cost
+                gen_partial0 = float(restored[1].get("wall_partial", 0.0))
+            else:
+                k_gen = k_run
+                scores_host[:] = np.nan
+            # the carried-key chain matches run_fused_pbt.one_generation
+            # exactly: next carry, train key, exploit key
+            k_run, k_train, k_pbt = jax.random.split(k_gen, 3)
+            wave_scores: list = [None] * n_waves
+            w0 = 0
+            if resumed_mid:
+                w0 = start_wave
+                for w in range(start_wave):
+                    off, wl_ = offs[w], wave_lens[w]
+                    # completed waves' scores round-trip exactly (f32)
+                    wave_scores[w] = jnp.asarray(scores_host[off : off + wl_])
+            for w in range(w0, n_waves):
+                off, wl_ = offs[w], wave_lens[w]
+                st, sc = _run_wave(
+                    trainer,
+                    pool_front,
+                    perm[off : off + wl_],
+                    off,
+                    unit,
+                    hparams_fn,
+                    train_x,
+                    train_y,
+                    val_x,
+                    val_y,
+                    k_train,
+                    steps_per_gen,
+                    population,
+                    mesh,
+                    engine,
+                    init_keys=member_keys[off : off + wl_] if g == 0 else None,
+                    sample_x=train_x[:2],
+                )
+                wave_scores[w] = sc
+                # async stage-out: the background fetch blocks on THIS
+                # wave's compute while the loop dispatches the next wave
+                engine.stage_out(
+                    {
+                        "state": {
+                            "params": st.params,
+                            "momentum": st.momentum,
+                            "step": st.step,
+                        },
+                        "scores": sc,
+                    },
+                    _writer(off),
+                )
+
+                def save_midgen(g=g, w=w):
+                    engine.drain()  # pools must hold every completed wave
+                    # COPY the pools: orbax's save is async, and the live
+                    # buffers are mutated in place by later waves' stage-out
+                    # writers — handing them over uncopied can tear the
+                    # snapshot (same contract as the resident path's
+                    # host-fetch-before-save)
+                    snap.save(
+                        g * n_waves + w + 1,
+                        sweep={
+                            "front": jax.tree.map(np.array, pool_front),
+                            "back": jax.tree.map(np.array, pool_back),
+                            "perm": np.asarray(perm),
+                            "unit": fetch_global(unit),
+                            "key_data": np.asarray(jax.random.key_data(k_gen)),
+                            "scores": scores_host.copy(),
+                        },
+                        meta_extra={
+                            "gen": g,
+                            "waves_done": w + 1,
+                            "best": best_list,
+                            "mean": mean_list,
+                            "member_fail": fail_list,
+                            "gen_walls": gen_walls,
+                            "wall_partial": time.perf_counter() - t_gen + gen_partial0,
+                        },
+                    )
+
+                if w + 1 < n_waves:
+                    # between-waves service point: heartbeat + graceful
+                    # drain with a mid-generation snapshot (completed
+                    # waves are never re-trained on resume)
+                    launch_boundary(
+                        f"pbt gen {g + 1}/{generations} wave {w + 1}/{n_waves}",
+                        final=False,
+                        snapshot=None if snap is None else save_midgen,
+                        launch=g * n_waves + w + 1,
+                        of=generations * n_waves,
+                    )
+            # generation boundary: the ONLY hard transfer barrier —
+            # exploit needs the full score vector and a settled pool
+            engine.drain()
+            scores_dev = jnp.concatenate([jnp.asarray(s) for s in wave_scores])
+            new_unit, src_idx, best, mean, n_fail, post = _wave_exploit(
+                k_pbt, unit, scores_dev, discrete_mask=disc, cfg=cfg
+            )
+            best_list.append(float(best))
+            mean_list.append(float(mean))
+            fail_list.append(int(n_fail))
+            unit = new_unit
+            perm = np.asarray(src_idx)
+            post_scores = np.asarray(post)
+            pool_front, pool_back = pool_back, pool_front
+            gen_walls.append(time.perf_counter() - t_gen + gen_partial0)
+            is_last = g + 1 == generations
+            due = (g + 1) % snapshot_every == 0
+
+            def save_boundary(g=g):
+                # COPY the pool: the async orbax write may still be in
+                # flight when this buffer (pool_back after the swap) is
+                # mutated in place by a LATER generation's stage-out
+                # writers — an uncopied save can mix generations' rows
+                # into one silently corrupt snapshot
+                snap.save(
+                    (g + 1) * n_waves,
+                    sweep={
+                        "front": jax.tree.map(np.array, pool_front),
+                        "perm": np.asarray(perm),
+                        "unit": fetch_global(unit),
+                        "key_data": np.asarray(jax.random.key_data(k_run)),
+                        "scores": post_scores,
+                    },
+                    meta_extra={
+                        "gen": g + 1,
+                        "waves_done": 0,
+                        "best": best_list,
+                        "mean": mean_list,
+                        "member_fail": fail_list,
+                        "gen_walls": gen_walls,
+                    },
+                )
+
+            saved = False
+            if snap is not None and ((due and not is_last) or (is_last and snapshot_last)):
+                save_boundary()
+                saved = True
+            launch_boundary(
+                f"pbt gen {g + 1}/{generations} wave {n_waves}/{n_waves}",
+                final=is_last,
+                snapshot=None if (snap is None or saved) else save_boundary,
+                launch=(g + 1) * n_waves,
+                of=generations * n_waves,
+            )
+    finally:
+        engine.close()
+        if snap is not None:
+            snap.close()
+
+    best_i, diverged = finite_winner(post_scores)
+    np_unit = fetch_global(unit)
+    # post-exploit population state, materialized on HOST (that is where
+    # a beyond-residency population lives): winners' rows via the perm
+    state = PopState(
+        params=jax.tree.map(lambda l: l[perm], pool_front["params"]),
+        momentum=jax.tree.map(lambda l: l[perm], pool_front["momentum"]),
+        step=pool_front["step"][perm],
+    )
+    return {
+        "best_score": float("nan") if diverged else float(post_scores[best_i]),
+        "best_params": None if diverged else space.materialize_row(np_unit[best_i]),
+        "diverged": diverged,
+        "best_curve": np.asarray(best_list, dtype=np.float32),
+        "mean_curve": np.asarray(mean_list, dtype=np.float32),
+        "member_failures": [int(v) for v in fail_list],
+        "state": state,
+        "unit": np_unit,
+        "launch_gens": [1] * generations,
+        "launch_walls": [float(v) for v in gen_walls],
+        # wave-scheduling observability (acceptance: staging must be
+        # visible, not inferred): bytes moved and how much of the
+        # transfer time the double buffer hid behind compute
+        "wave_size": wave_size,
+        "wave_lens": list(wave_lens),
+        "n_waves": n_waves,
+        "staged_bytes": int(engine.staged_bytes),
+        "stage_transfer_s": float(engine.transfer_s),
+        "stage_wait_s": float(engine.wait_s),
+        "stage_overlap_s": float(engine.overlap_s),
+    }
+
+
 def _run_stepped_generation(
     trainer,
     state,
@@ -183,6 +640,7 @@ def fused_pbt(
     member_chunk: int = 0,
     gen_chunk: int = 0,
     step_chunk: int = 0,
+    wave_size=0,
     checkpoint_dir: str = None,
     snapshot_every: int = 1,
     snapshot_last: bool = True,
@@ -256,6 +714,51 @@ def fused_pbt(
     trainer, space, train_x, train_y, val_x, val_y = workload_arrays(
         workload, member_chunk, mesh
     )
+    # wave scheduling (population > residency): resolve the cap, then
+    # hand off to the host-staged driver. ``auto`` sizes the wave from
+    # a residency estimate; a cap at or above the population means
+    # everything fits — resident mode, the bit-identical baseline.
+    if wave_size:
+        from mpi_opt_tpu.train.staging import estimate_wave_size
+
+        if wave_size == "auto":
+            wave_size = estimate_wave_size(trainer, train_x[:2], population, mesh)
+        wave_size = int(wave_size)
+        if wave_size < 0:
+            raise ValueError(f"wave_size must be >= 0, got {wave_size}")
+        if 0 < wave_size < population:
+            if step_chunk > 0 or gen_chunk > 1:
+                raise ValueError(
+                    "wave_size schedules whole generations as resident "
+                    "waves; combining it with gen_chunk/step_chunk launch "
+                    "splitting is ambiguous"
+                )
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "wave scheduling stages members through THIS process's "
+                    "host memory; under multi-process SPMD shard the "
+                    "population over the mesh 'pop' axis instead"
+                )
+            return _fused_pbt_waves(
+                workload,
+                trainer,
+                space,
+                train_x,
+                train_y,
+                val_x,
+                val_y,
+                population,
+                generations,
+                steps_per_gen,
+                seed,
+                cfg,
+                mesh,
+                member_chunk,
+                wave_size,
+                checkpoint_dir,
+                snapshot_every,
+                snapshot_last,
+            )
     key = jax.random.key(seed)
     k_init, k_unit, k_run = jax.random.split(key, 3)
 
@@ -306,6 +809,10 @@ def fused_pbt(
                 # trainer would crash in the scan carry (or silently change
                 # numerics) instead of refusing cleanly here
                 "momentum_dtype": momentum_dtype_str(),
+                # resident mode is wave_size=0; a wave-scheduled snapshot
+                # (different payload: host pools + perm) must be refused
+                # here, not crash in PopState reconstruction
+                "wave_size": 0,
             },
         )
         restored = snap.restore_population_sweep()

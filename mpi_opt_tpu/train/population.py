@@ -161,7 +161,19 @@ class PopulationTrainer:
 
     @functools.partial(jax.jit, static_argnames=("self", "n"))
     def init_population(self, key: jax.Array, sample_x: jax.Array, n: int) -> PopState:
-        keys = jax.random.split(key, n)
+        return self.init_members(jax.random.split(key, n), sample_x)
+
+    @functools.partial(jax.jit, static_argnames=("self",))
+    def init_members(self, keys: jax.Array, sample_x: jax.Array) -> PopState:
+        """Init one member per key (leading axis = member).
+
+        The wave-sliced form of ``init_population``: member m of a
+        P-member population inits from ``split(key, P)[m]`` whether it
+        lands on device as part of the full resident cohort or as a
+        host-staged wave (``train/staging.py``) — so wave-mode initial
+        weights are bit-identical to resident mode's.
+        """
+        n = keys.shape[0]
         params = jax.vmap(lambda k: self.init_fn(k, sample_x))(keys)
         dt = self.momentum_dtype
         momentum = jax.tree.map(lambda p: jnp.zeros(p.shape, dt or p.dtype), params)
@@ -242,6 +254,53 @@ class PopulationTrainer:
             by = jnp.take(train_y, idx, axis=0)
             bx, by = self._constrain_data(bx, by)
             member_keys = jax.random.split(k_aug, n)
+            st, loss = self._pop_update(st, hp, member_keys, bx, by)
+            return (st, k), jnp.mean(loss)
+
+        (state, _), losses = jax.lax.scan(one_step, (state, key), jnp.arange(steps))
+        return state, losses
+
+    def _train_segment_window(
+        self,
+        state: PopState,
+        hp: OptHParams,
+        train_x: jax.Array,
+        train_y: jax.Array,
+        key: jax.Array,
+        steps: int,
+        n_total: int,  # static: full population size
+        offset: jax.Array,  # int32: this wave's first member index
+    ) -> tuple[PopState, jax.Array]:
+        """``_train_segment`` for a WAVE of a larger population: the
+        state holds members [offset, offset+W) of an ``n_total``-member
+        population (host-staged wave scheduling, train/staging.py).
+
+        Bit-identity contract with the resident program: the batch key
+        chain threads exactly as in ``_train_segment`` (the minibatch is
+        shared population-wide, so every wave of a generation must draw
+        the SAME batch sequence — they do, by receiving the same
+        ``key``), and per-member augmentation keys are the wave's WINDOW
+        of the full population's per-step split — member m sees
+        ``split(k_aug, n_total)[m]`` whether it trains resident or in a
+        wave. ``offset`` is traced (dynamic_slice on the key data), so
+        all same-sized waves share one compiled program.
+        """
+        n = state.step.shape[0]
+        n_data = train_x.shape[0]
+
+        def one_step(carry, t):
+            st, k = carry
+            k, k_batch, k_aug = jax.random.split(k, 3)
+            idx = jax.random.randint(k_batch, (self.batch_size,), 0, n_data)
+            bx = jnp.take(train_x, idx, axis=0)
+            by = jnp.take(train_y, idx, axis=0)
+            bx, by = self._constrain_data(bx, by)
+            all_keys = jax.random.split(k_aug, n_total)
+            member_keys = jax.random.wrap_key_data(
+                jax.lax.dynamic_slice_in_dim(
+                    jax.random.key_data(all_keys), offset, n, axis=0
+                )
+            )
             st, loss = self._pop_update(st, hp, member_keys, bx, by)
             return (st, k), jnp.mean(loss)
 

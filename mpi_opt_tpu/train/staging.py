@@ -1,0 +1,270 @@
+"""Host-staged member waves: population > device residency.
+
+The single-chip population envelope is RESIDENCY-bound, not speed-bound
+(PERF_NOTES "single-chip population envelope": pop=1024 SmallCNN is
+4.5 GB of params+momentum and dies RESOURCE_EXHAUSTED at warmup while
+member throughput stays flat to pop=512). The reference's MPI worker
+pool never hits this wall — members live in host processes and visit
+the accelerator one trial at a time. This module is the fused-path
+answer: keep a resident WAVE of W members on device, stream the cold
+population through host memory, and hide the host<->device transfer
+cost behind wave compute.
+
+Three pieces:
+
+- ``StagingEngine``: a single background worker thread that fetches
+  trained wave state device->host (``jax.device_get`` blocks until the
+  wave's compute completes, so the fetch doubles as that wave's
+  completion barrier) and writes it into the host pool. The main thread
+  meanwhile dispatches the NEXT wave's stage-in + compute — on this
+  container's ~15-16 MB/s tunnel (PERF_NOTES round-5 addendum) a
+  serial fetch per wave would dominate the sweep, so stage-out of wave
+  k overlapping compute of wave k+1 is the difference between the
+  feature existing and not. ``drain()`` is the generation boundary's
+  completion barrier; its block time is the UN-hidden remainder of the
+  transfer cost, which is why the engine accounts both.
+
+- Host pool helpers: the cold population lives as one numpy pytree with
+  a leading [P] member axis (``population_pool``, built from abstract
+  member shapes); waves slice rows out (``stage_in``) and the engine
+  writes trained rows back (``write_rows``). Two pools ping-pong per generation (read the
+  previous generation's states while writing this generation's), which
+  is what lets the NEXT generation's stage-in apply the exploit
+  source-index map lazily — the winner gather becomes an indexed read,
+  not an extra full-population copy.
+
+- ``estimate_wave_size``: the ``--wave-size auto`` residency estimate —
+  per-member params+momentum bytes from ``jax.eval_shape`` (no compute,
+  no allocation) against the device's reported memory budget, with
+  double-buffer + activation headroom.
+
+Memory contract: device holds at most TWO waves (the one computing and
+the one being fetched); host holds two full population pools plus one
+wave-sized staging slice.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+
+def tree_bytes(tree: Any) -> int:
+    """Total leaf bytes of an array pytree (host or device)."""
+    return sum(
+        int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+        for l in jax.tree.leaves(tree)
+    )
+
+
+def population_pool(trainer, sample_x, population: int) -> dict:
+    """Zeroed host pool for a full population's carried state, from
+    ABSTRACT member shapes (``jax.eval_shape`` over the trainer's init:
+    no device allocation — the whole point is that the full population
+    never exists on device). Layout matches ``PopState`` fields."""
+    params_sd = jax.eval_shape(trainer.init_fn, jax.random.key(0), sample_x)
+    mk = lambda sd, dt: np.zeros((population,) + tuple(sd.shape), np.dtype(dt))
+    dt = trainer.momentum_dtype
+    return {
+        "params": jax.tree.map(lambda sd: mk(sd, sd.dtype), params_sd),
+        "momentum": jax.tree.map(lambda sd: mk(sd, dt or sd.dtype), params_sd),
+        "step": np.zeros((population,), np.int32),
+    }
+
+
+def stage_in(pool: Any, rows: np.ndarray, mesh=None) -> Any:
+    """Device copy of ``pool``'s ``rows`` (host gather + device_put).
+
+    ``rows`` is an index array, so the previous generation's exploit
+    source map composes for free: passing ``perm[lo:hi]`` stages in the
+    WINNERS' states — the MPI weight transfer of the reference, as a
+    host-side indexed read. With a mesh the wave lands sharded over
+    'pop' (replicated, with the standard warning, when the wave size
+    does not divide the axis). device_put is async — dispatching the
+    wave's compute right after overlaps the upload with whatever the
+    device is still finishing.
+    """
+    sliced = jax.tree.map(lambda l: l[rows], pool)
+    if mesh is None:
+        return jax.device_put(sliced)
+    from mpi_opt_tpu.parallel.mesh import shard_popstate
+
+    return shard_popstate(sliced, mesh)
+
+
+def write_rows(pool: Any, lo: int, host_tree: Any) -> None:
+    """Write a fetched wave (host arrays) into pool rows [lo, lo+W)."""
+
+    def _assign(dst, src):
+        dst[lo : lo + src.shape[0]] = src
+
+    jax.tree.map(_assign, pool, host_tree)
+
+
+class StagingEngine:
+    """One background transfer thread + overlap accounting.
+
+    ``stage_out(tree, on_host)`` enqueues: the worker fetches ``tree``
+    to host (blocking THERE, not on the main thread) and calls
+    ``on_host(host_tree)`` — jobs run strictly FIFO so pool writes are
+    ordered. ``drain()`` blocks until every enqueued job has completed
+    and re-raises the first worker error.
+
+    Accounting (surfaced as ``staged_bytes`` / ``stage_overlap_s`` in
+    sweep results and the metrics summary):
+    - ``staged_bytes``: bytes moved, both directions (``note_bytes``
+      adds the main thread's stage-in puts).
+    - ``transfer_s``: worker busy seconds (fetch + pool write).
+    - ``wait_s``: main-thread seconds blocked in ``drain()`` — the
+      transfer cost that compute did NOT hide.
+    - ``overlap_s`` = max(0, transfer_s - wait_s): the hidden part. A
+      healthy wave schedule has overlap_s ~ transfer_s and wait_s ~ the
+      final wave's fetch only.
+    """
+
+    def __init__(self):
+        self._q: "queue.Queue" = queue.Queue()
+        self._errors: list[BaseException] = []
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._pending = 0
+        self.staged_bytes = 0
+        self.transfer_s = 0.0
+        self.wait_s = 0.0
+        self._thread = threading.Thread(
+            target=self._loop, name="mpi-opt-staging", daemon=True
+        )
+        self._thread.start()
+        self._closed = False
+
+    # -- worker ----------------------------------------------------------
+
+    def _loop(self):
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            tree, on_host = job
+            t0 = time.perf_counter()
+            try:
+                # device_get blocks until the arrays' producing programs
+                # finish — this IS the wave's completion barrier, paid
+                # on this thread while the main thread dispatches ahead
+                host = jax.device_get(tree)
+                on_host(host)
+                with self._lock:
+                    self.staged_bytes += tree_bytes(host)
+            except BaseException as e:  # surfaced by drain()
+                with self._lock:
+                    self._errors.append(e)
+            finally:
+                with self._lock:
+                    self.transfer_s += time.perf_counter() - t0
+                    self._pending -= 1
+                    if self._pending == 0:
+                        self._idle.notify_all()
+
+    # -- main-thread API -------------------------------------------------
+
+    def stage_out(self, tree: Any, on_host: Callable[[Any], None]) -> None:
+        if self._closed:
+            raise RuntimeError("StagingEngine is closed")
+        with self._lock:
+            if self._errors:  # fail fast instead of queueing onto a wreck
+                raise self._errors[0]
+            self._pending += 1
+        self._q.put((tree, on_host))
+
+    def note_bytes(self, n: int) -> None:
+        """Account main-thread transfer bytes (stage-in device_puts)."""
+        with self._lock:
+            self.staged_bytes += int(n)
+
+    def drain(self) -> None:
+        """Completion barrier: block until all enqueued transfers are
+        done; re-raise the first worker error. Block time is accounted
+        as un-hidden transfer cost (``wait_s``)."""
+        t0 = time.perf_counter()
+        with self._idle:
+            while self._pending:
+                self._idle.wait(timeout=0.5)
+            self.wait_s += time.perf_counter() - t0
+            if self._errors:
+                raise self._errors[0]
+
+    @property
+    def overlap_s(self) -> float:
+        return max(0.0, self.transfer_s - self.wait_s)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._thread.join(timeout=60)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def estimate_wave_size(
+    trainer,
+    sample_x,
+    population: int,
+    mesh=None,
+    budget_bytes: Optional[int] = None,
+) -> int:
+    """Residency estimate for ``--wave-size auto``: the largest wave the
+    device budget fits with double-buffer + activation headroom.
+
+    Per-member bytes come from ``jax.eval_shape`` over the trainer's
+    init (abstract — no compute, no allocation): params at their own
+    dtypes plus momentum at the trainer's storage dtype. The budget is
+    ``budget_bytes``, else the device's reported ``bytes_limit``
+    (``memory_stats`` — absent on CPU), else the
+    ``MPI_OPT_TPU_DEVICE_BYTES`` env var, else a conservative 8 GiB.
+    Only ~35% of it is offered to ONE wave's params+momentum: the wave
+    loop keeps up to two waves resident (compute + in-flight fetch) and
+    training needs activation/update headroom on top (the measured
+    envelope: 4.5 GB of state tipped a 16 GB chip — PERF_NOTES).
+
+    With a mesh the wave shards over the 'pop' axis, so the budget
+    scales by that axis and the result is rounded DOWN to a multiple of
+    it (replicated waves would defeat the mesh silently). Returns a
+    value in [1, population]; ``population`` means everything fits —
+    callers run resident mode.
+    """
+    params_sd = jax.eval_shape(trainer.init_fn, jax.random.key(0), sample_x)
+    p_bytes = tree_bytes(params_sd)
+    m_dt = trainer.momentum_dtype
+    if m_dt is None:
+        m_bytes = p_bytes
+    else:
+        itemsize = np.dtype(m_dt).itemsize
+        m_bytes = sum(
+            int(np.prod(l.shape)) * itemsize for l in jax.tree.leaves(params_sd)
+        )
+    per_member = p_bytes + m_bytes
+    if budget_bytes is None:
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            budget_bytes = int(stats.get("bytes_limit", 0)) or None
+        except Exception:
+            budget_bytes = None
+    if budget_bytes is None:
+        env = os.environ.get("MPI_OPT_TPU_DEVICE_BYTES")
+        budget_bytes = int(env) if env else 8 << 30
+    n_pop = int(mesh.shape["pop"]) if mesh is not None else 1
+    w = int(budget_bytes * 0.35 * n_pop // max(1, per_member))
+    if mesh is not None and w > n_pop:
+        w -= w % n_pop
+    return max(1, min(population, w))

@@ -177,6 +177,8 @@ class SweepCheckpointer:
             saved.setdefault("init_unit_digest", None)
         if "step_chunk" in self.config:
             saved.setdefault("step_chunk", 0)  # pre-upgrade sweeps were unchunked
+        if "wave_size" in self.config:
+            saved.setdefault("wave_size", 0)  # pre-upgrade sweeps were resident
         if saved != self.config:
             # close before raising: callers only reach their own close()
             # via try/finally blocks entered AFTER a successful restore
@@ -232,6 +234,31 @@ class SweepCheckpointer:
             },
             meta_extra=meta_extra,
         )
+
+    # -- wave-scheduled sweep payload (host-staged populations) -----------
+
+    def restore_wave_sweep(self):
+        """(sweep_payload, meta) for a wave-scheduled fused sweep, or
+        None; ValueError on config mismatch (restore() closes on that
+        path). The payload's arrays are host numpy by construction — a
+        beyond-residency population LIVES on host, so wave snapshots
+        save the staging pools directly, no device fetch involved.
+        Two shapes, discriminated by ``meta['waves_done']``:
+
+        - generation boundary (``waves_done == 0``): ``front`` (the
+          post-training pool), ``perm`` (the exploit source map the next
+          generation's stage-in applies), ``unit``, ``key_data`` (the
+          next carried key), ``scores`` (post-exploit).
+        - between waves (``waves_done == k``): both pools (``front``
+          read / ``back`` written-through-wave-k), ``perm``, ``unit``,
+          ``key_data`` (the PRE-generation carried key — train/exploit
+          keys re-derive from it on resume), ``scores`` (pre-exploit,
+          NaN past the completed prefix).
+
+        Key wrapping and pool writability (orbax may restore read-only
+        arrays) are the caller's job — see train/fused_pbt.py.
+        """
+        return self.restore()
 
     def restore_population_sweep(self):
         """(PopState, unit, key, scores, meta) from the latest snapshot,

@@ -40,6 +40,14 @@ class MetricsLogger:
         # kills appear in the supervisor's stall/done/failed events)
         self.preempted = 0
         self.stalls_detected = 0
+        # staging-layer counters (train/staging.py, wave-scheduled fused
+        # sweeps): staged_bytes counts host<->device bytes moved by the
+        # background transfer engine; stage_overlap_s is how much of the
+        # transfer time was hidden behind wave compute (transfer busy
+        # time minus the main thread's barrier waits — the double
+        # buffer's whole point, so it must be observable)
+        self.staged_bytes = 0
+        self.stage_overlap_s = 0.0
 
     def log(self, event: str, **fields) -> dict:
         # `t` is relative (this process's clock, for intra-run deltas);
@@ -89,6 +97,11 @@ class MetricsLogger:
         """Stalled (hung-but-alive) executions detected and killed."""
         self.stalls_detected += n
 
+    def count_staging(self, staged_bytes: int = 0, overlap_s: float = 0.0):
+        """Host-staging traffic from a wave-scheduled fused sweep."""
+        self.staged_bytes += int(staged_bytes)
+        self.stage_overlap_s += float(overlap_s)
+
     @property
     def wall(self) -> float:
         return time.perf_counter() - self.t_start
@@ -107,6 +120,8 @@ class MetricsLogger:
             replayed=self.replayed,
             preempted=self.preempted,
             stalls_detected=self.stalls_detected,
+            staged_bytes=self.staged_bytes,
+            stage_overlap_s=round(self.stage_overlap_s, 3),
             wall_s=round(self.wall, 3),
             trials_per_sec_per_chip=round(self.trials_per_sec_per_chip(), 4),
             **extra,

@@ -879,3 +879,79 @@ def test_cli_heartbeat_file_beats_per_batch(tmp_path, capsys):
     assert rec is not None and rec["beats"] == 4  # one per batch
     assert rec["progress"]["stage"] == "driver"
     assert heartbeat.active() is None  # deconfigured on the way out
+
+
+def test_cli_wave_size_validation(capsys):
+    """--wave-size bad values / wrong context are usage errors (rc=2),
+    not tracebacks from fused_pbt deep in the run."""
+    base = ["--workload", "fashion_mlp", "--algorithm", "pbt"]
+    for argv in (
+        base + ["--wave-size", "4"],  # requires --fused
+        base + ["--fused", "--wave-size", "nope"],
+        base + ["--fused", "--wave-size", "-1"],
+        base + ["--fused", "--wave-size", "4", "--step-chunk", "2"],
+        base + ["--fused", "--wave-size", "4", "--gen-chunk", "2"],
+        ["--workload", "fashion_mlp", "--algorithm", "tpe", "--fused",
+         "--wave-size", "4"],
+    ):
+        with pytest.raises(SystemExit) as ei:
+            main(argv)
+        assert ei.value.code == 2
+        capsys.readouterr()
+
+
+def test_cli_fused_wave_summary_surfaces_staging(capsys):
+    """--fused --wave-size: the summary JSON and the metrics summary
+    both carry the staging observability (staged_bytes + overlap)."""
+    rc = main([
+        "--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+        "--population", "8", "--generations", "2",
+        "--steps-per-generation", "3", "--wave-size", "4", "--no-mesh",
+        "--seed", "0",
+    ])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    summary = json.loads(lines[-1])
+    assert summary["wave_size"] == 4 and summary["n_waves"] == 2
+    assert summary["staged_bytes"] > 0
+    assert summary["stage_overlap_s"] >= 0
+    msum = [json.loads(l) for l in lines if '"event": "summary"' in l][-1]
+    assert msum["staged_bytes"] == summary["staged_bytes"]
+    assert msum["stage_overlap_s"] >= 0
+
+
+def test_cli_fused_diverged_summary_is_strict_json(capsys, monkeypatch):
+    """ADVICE r5: an all-diverged fused sweep's NaNs (best_score AND
+    curve entries) must serialize as null — json.dumps' bare NaN token
+    breaks the single-JSON-line contract for strict parsers."""
+    import mpi_opt_tpu.train.fused_pbt as fp
+
+    nan = float("nan")
+    diverged = {
+        "best_score": nan,
+        "best_params": None,
+        "diverged": True,
+        "best_curve": [0.5, nan],
+        "mean_curve": [0.4, nan],
+        "member_failures": [0, 8],
+        "state": None,
+        "unit": None,
+        "launch_gens": [1, 1],
+        "launch_walls": [0.1, 0.1],
+    }
+    monkeypatch.setattr(fp, "fused_pbt", lambda *a, **k: diverged)
+    rc = main([
+        "--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+        "--population", "8", "--generations", "2",
+        "--steps-per-generation", "3", "--no-mesh",
+    ])
+    assert rc == 0
+    line = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")][-1]
+
+    def no_constants(s):  # NaN/Infinity tokens -> hard failure
+        raise AssertionError(f"non-JSON constant emitted: {s}")
+
+    summary = json.loads(line, parse_constant=no_constants)
+    assert summary["best_score"] is None
+    assert summary["best_params"] is None
+    assert summary["best_curve"] == [0.5, None]

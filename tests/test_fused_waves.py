@@ -1,0 +1,289 @@
+"""Wave-scheduled fused PBT: populations beyond device residency.
+
+The tentpole contract (ISSUE 4): with ``wave_size=W < population``, each
+generation trains resident waves of W members in sequence, staging cold
+members' params+momentum on host between waves, while exploit/explore at
+the generation boundary operates over the FULL population. On the CPU
+backend wave mode is BIT-IDENTICAL to resident mode (stronger than the
+step_chunk documented-equivalent standard): batch RNG is shared
+population-wide, member RNG windows the full split, and the
+unit->hparams mapping is applied in-program (eager/compiled transform
+ulps would otherwise flip discrete augmentation draws — see
+``_wave_train_program``).
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+
+import mpi_opt_tpu.train.fused_pbt as fp
+from mpi_opt_tpu.health import shutdown
+from mpi_opt_tpu.ops.pbt import PBTConfig
+from mpi_opt_tpu.workloads import get_workload
+
+
+@pytest.fixture(scope="module")
+def wl():
+    # one instance for the whole module: workload_arrays caches the
+    # trainer on it, so every test shares one compile set
+    return get_workload("fashion_mlp", n_train=256, n_val=128)
+
+
+KW = dict(population=8, generations=3, steps_per_gen=4, seed=2)
+
+
+def _tree_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def test_wave_mode_bit_identical_to_resident(wl):
+    """pop <= residency parity: a forced wave cap (including a
+    NON-dividing one — balanced waves [3,3,2]) reproduces the resident
+    scan bit-for-bit: curves, hparams, winner, params AND momentum."""
+    res = fp.fused_pbt(wl, **KW)
+    wav = fp.fused_pbt(wl, wave_size=3, **KW)
+    np.testing.assert_array_equal(res["best_curve"], wav["best_curve"])
+    np.testing.assert_array_equal(res["mean_curve"], wav["mean_curve"])
+    np.testing.assert_array_equal(res["unit"], wav["unit"])
+    assert res["best_score"] == wav["best_score"]
+    assert res["best_params"] == wav["best_params"]
+    assert res["member_failures"] == wav["member_failures"]
+    assert _tree_equal(res["state"].params, wav["state"].params)
+    assert _tree_equal(res["state"].momentum, wav["state"].momentum)
+    # staging observability: cold members really moved through host
+    assert wav["n_waves"] == 3 and wav["wave_lens"] == [3, 3, 2]
+    assert wav["staged_bytes"] > 0
+    assert wav["stage_transfer_s"] >= 0 and wav["stage_overlap_s"] >= 0
+
+
+def test_wave_mode_bit_identical_on_mesh():
+    """Same parity on the virtual 8-device CPU mesh: waves shard over
+    'pop' (W=8 divides the axis) and the result still matches the
+    resident sharded sweep exactly."""
+    from mpi_opt_tpu.parallel.mesh import make_mesh
+
+    wl = get_workload("fashion_mlp", n_train=256, n_val=128)
+    mesh = make_mesh(n_pop=8, n_data=1)
+    kw = dict(population=16, generations=2, steps_per_gen=3, seed=3)
+    res = fp.fused_pbt(wl, mesh=mesh, **kw)
+    wav = fp.fused_pbt(wl, mesh=mesh, wave_size=8, **kw)
+    np.testing.assert_array_equal(res["best_curve"], wav["best_curve"])
+    np.testing.assert_array_equal(res["unit"], wav["unit"])
+    assert res["best_score"] == wav["best_score"]
+    assert _tree_equal(res["state"].params, wav["state"].params)
+
+
+def test_wave_cap_at_or_above_population_runs_resident(wl):
+    """wave_size >= population means everything fits: the resident path
+    runs (no staging machinery, no wave keys in the result)."""
+    res = fp.fused_pbt(wl, wave_size=KW["population"], **KW)
+    assert "wave_size" not in res
+    assert "staged_bytes" not in res
+
+
+def test_full_population_exploit_crosses_wave_boundaries(wl):
+    """pop > residency semantics: truncation selection must rank ALL
+    members, not each wave separately. With truncation 1/8 (n_cut=1)
+    every loser exploits THE global-best member — the test asserts that
+    a loser in one wave selected a source member from a DIFFERENT wave
+    (the cold member with the global-best score), i.e. winner weights
+    crossed a wave boundary through the host pool."""
+    spy = []
+    real = fp._wave_exploit
+
+    def recording(key, unit, scores, **kw):
+        out = real(key, unit, scores, **kw)
+        spy.append((np.asarray(scores), np.asarray(out[1])))
+        return out
+
+    fp._wave_exploit = recording
+    try:
+        wav = fp.fused_pbt(
+            wl, wave_size=2, cfg=PBTConfig(truncation_frac=1 / 8), **KW
+        )
+    finally:
+        fp._wave_exploit = real
+    assert len(spy) == KW["generations"]
+    wave_of = lambda i: i // 2  # wave_size=2: members [2k, 2k+1] share a wave
+    crossed = 0
+    for scores, src in spy:
+        exploited = np.nonzero(src != np.arange(len(src)))[0]
+        assert len(exploited) == 1  # n_cut=1: exactly one loser per gen
+        for i in exploited:
+            # full-population semantics: the source is the GLOBAL best
+            assert src[i] == int(np.argmax(scores))
+            if wave_of(src[i]) != wave_of(i):
+                crossed += 1
+    assert crossed > 0, "pinned seed should exploit across a wave boundary"
+    assert 0.0 <= wav["best_score"] <= 1.0
+
+
+def test_wave_crash_resume_bit_identical(wl, tmp_path):
+    """Hard crash mid-sweep: resume from the generation-boundary
+    snapshot finishes with the uninterrupted sweep's exact result."""
+    whole = fp.fused_pbt(wl, wave_size=3, **KW)
+    real = fp._run_wave
+    calls = {"n": 0}
+
+    def crashing(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 5:  # gen 0 = 3 waves; die inside gen 1
+            raise RuntimeError("simulated TPU worker crash")
+        return real(*a, **k)
+
+    ckpt = str(tmp_path / "ck")
+    fp._run_wave = crashing
+    try:
+        with pytest.raises(RuntimeError, match="simulated"):
+            fp.fused_pbt(wl, wave_size=3, checkpoint_dir=ckpt, **KW)
+    finally:
+        fp._run_wave = real
+    resumed = fp.fused_pbt(wl, wave_size=3, checkpoint_dir=ckpt, **KW)
+    np.testing.assert_array_equal(resumed["best_curve"], whole["best_curve"])
+    np.testing.assert_array_equal(resumed["unit"], whole["unit"])
+    assert resumed["best_score"] == whole["best_score"]
+    assert len(resumed["launch_walls"]) == KW["generations"]
+
+
+def test_wave_preempt_between_waves_resumes_without_retraining(wl, tmp_path):
+    """Graceful shutdown BETWEEN waves flushes a mid-generation
+    snapshot; the resume re-trains only the remaining waves (completed
+    waves' states come from the host pools) and still reproduces the
+    clean run bit-for-bit."""
+    whole = fp.fused_pbt(wl, wave_size=3, **KW)
+    ckpt = str(tmp_path / "ck")
+    real = fp._run_wave
+    calls = {"n": 0}
+
+    def preempting(*a, **k):
+        calls["n"] += 1
+        out = real(*a, **k)
+        if calls["n"] == 4:  # after gen 1 wave 1 -> drain at wave boundary
+            os.kill(os.getpid(), signal.SIGTERM)
+        return out
+
+    with shutdown.ShutdownGuard():
+        fp._run_wave = preempting
+        try:
+            with pytest.raises(shutdown.SweepInterrupted):
+                fp.fused_pbt(wl, wave_size=3, checkpoint_dir=ckpt, **KW)
+        finally:
+            fp._run_wave = real
+    counting = {"n": 0}
+
+    def counted(*a, **k):
+        counting["n"] += 1
+        return real(*a, **k)
+
+    fp._run_wave = counted
+    try:
+        resumed = fp.fused_pbt(wl, wave_size=3, checkpoint_dir=ckpt, **KW)
+    finally:
+        fp._run_wave = real
+    # 2 waves left in gen 1 + 3 in gen 2; the snapshot's completed wave
+    # is NOT re-trained
+    assert counting["n"] == 5
+    np.testing.assert_array_equal(resumed["best_curve"], whole["best_curve"])
+    assert resumed["best_score"] == whole["best_score"]
+    assert _tree_equal(resumed["state"].params, whole["state"].params)
+
+
+def test_wave_resume_after_completion_runs_nothing(wl, tmp_path):
+    ckpt = str(tmp_path / "ck")
+    first = fp.fused_pbt(wl, wave_size=3, checkpoint_dir=ckpt, **KW)
+    real = fp._run_wave
+
+    def boom(*a, **k):
+        raise AssertionError("completed sweep re-ran a wave")
+
+    fp._run_wave = boom
+    try:
+        again = fp.fused_pbt(wl, wave_size=3, checkpoint_dir=ckpt, **KW)
+    finally:
+        fp._run_wave = real
+    np.testing.assert_array_equal(again["best_curve"], first["best_curve"])
+    assert again["best_score"] == first["best_score"]
+
+
+def test_wave_snapshot_refused_by_resident_resume(wl, tmp_path):
+    """wave_size is part of the checkpoint config identity: the wave
+    payload (host pools + perm) must not load into a resident run."""
+    ckpt = str(tmp_path / "ck")
+    fp.fused_pbt(wl, wave_size=3, checkpoint_dir=ckpt, **KW)
+    with pytest.raises(ValueError, match="different sweep"):
+        fp.fused_pbt(wl, checkpoint_dir=ckpt, **KW)
+
+
+def test_wave_rejects_launch_chunking(wl):
+    with pytest.raises(ValueError, match="ambiguous"):
+        fp.fused_pbt(wl, wave_size=3, step_chunk=2, **KW)
+    with pytest.raises(ValueError, match="ambiguous"):
+        fp.fused_pbt(wl, wave_size=3, gen_chunk=2, **KW)
+
+
+# -- staging engine unit tests -------------------------------------------
+
+
+def test_staging_engine_roundtrip_and_accounting():
+    import jax.numpy as jnp
+
+    from mpi_opt_tpu.train import staging
+
+    eng = staging.StagingEngine()
+    pool = {"a": np.zeros((8, 4), np.float32)}
+    dev = jnp.ones((2, 4), jnp.float32) * 7
+
+    eng.stage_out({"state": {"a": dev}, "scores": jnp.zeros((2,))},
+                  lambda host: staging.write_rows(pool, 2, host["state"]))
+    eng.drain()
+    assert np.array_equal(pool["a"][2:4], np.full((2, 4), 7.0))
+    assert np.array_equal(pool["a"][:2], np.zeros((2, 4)))
+    assert eng.staged_bytes == 2 * 4 * 4 + 2 * 4  # state + f32 scores
+    assert eng.transfer_s >= 0 and eng.wait_s >= 0
+    eng.close()
+
+
+def test_staging_engine_propagates_worker_errors():
+    from mpi_opt_tpu.train import staging
+
+    eng = staging.StagingEngine()
+
+    def bad(host):
+        raise RuntimeError("writer exploded")
+
+    eng.stage_out({"x": np.zeros(3)}, bad)
+    with pytest.raises(RuntimeError, match="writer exploded"):
+        eng.drain()
+    eng.close()
+
+
+def test_stage_in_applies_permutation():
+    from mpi_opt_tpu.train import staging
+
+    pool = {"a": np.arange(8, dtype=np.float32).reshape(8, 1)}
+    dev = staging.stage_in(pool, np.array([5, 1, 6]))
+    assert np.asarray(dev["a"]).ravel().tolist() == [5.0, 1.0, 6.0]
+
+
+def test_estimate_wave_size_respects_budget_and_population(wl):
+    from mpi_opt_tpu.train.common import workload_arrays
+    from mpi_opt_tpu.train.staging import estimate_wave_size, tree_bytes
+
+    trainer, _, tx, *_ = workload_arrays(wl, 0, None)
+    # a generous budget fits everything -> resident signal
+    assert estimate_wave_size(trainer, tx[:2], 8, budget_bytes=1 << 40) == 8
+    # a tiny budget still returns a runnable wave of at least 1
+    assert estimate_wave_size(trainer, tx[:2], 8, budget_bytes=1) == 1
+    # a budget sized for ~2 members (past the 0.35 safety factor) caps
+    # the wave below the population
+    params_sd = jax.eval_shape(trainer.init_fn, jax.random.key(0), tx[:2])
+    member = 2 * tree_bytes(params_sd)  # params + f32 momentum
+    w = estimate_wave_size(trainer, tx[:2], 8, budget_bytes=int(member * 2 / 0.35))
+    assert 1 <= w <= 2

@@ -537,3 +537,83 @@ def test_stall_watchdog_ignores_ranks_that_exited_cleanly(tmp_path, monkeypatch,
     assert events[-1] == {
         "event": "done", "attempts": 1, "preemptions": 0, "stalls_detected": 0,
     }
+
+
+def test_find_summary_line_skips_trailing_noise():
+    """VERDICT weak #5: the supervisor re-surfaces rank 0's summary by
+    SHAPE (a JSON object that is not a metrics event), so trailing
+    non-summary output no longer breaks the single-JSON-line relay."""
+    summary = '{"workload": "digits", "algorithm": "random", "best_score": 0.9}'
+    text = "\n".join([
+        '{"event": "summary", "trials": 4}',
+        summary,
+        '{"event": "late_flush", "t": 1.0}',  # metrics event AFTER the summary
+        "some stray library print",
+        "",
+    ])
+    assert launch._find_summary_line(text) == summary
+
+
+def test_find_summary_line_handles_aborted_and_preempted_shapes():
+    for line in ('{"aborted": "failure rate 0.9 over 0.5"}',
+                 '{"preempted": true, "signal": "SIGTERM"}'):
+        assert launch._find_summary_line(line + "\ntrailing\n") == line
+
+
+def test_find_summary_line_none_when_no_json():
+    assert launch._find_summary_line("plain text\nmore text\n") is None
+    assert launch._find_summary_line("") is None
+
+
+def test_spawn_ranks_cleans_up_on_midloop_failure(tmp_path, monkeypatch):
+    """ADVICE r5: if Popen dies mid-loop, already-spawned ranks must be
+    killed (they would orphan inside jax.distributed bring-up waiting
+    for peers that never start) and their log handles closed."""
+    spawned = []
+
+    class FakeProc:
+        def __init__(self):
+            self.killed = False
+            self._rc = None
+
+        def poll(self):
+            return self._rc
+
+        def kill(self):
+            self.killed = True
+            self._rc = -9
+
+        def wait(self):
+            self._rc = self._rc if self._rc is not None else -9
+            return self._rc
+
+    calls = {"n": 0}
+
+    def fake_popen(argv, stdout=None, stderr=None, text=None):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise OSError("fork failed (EAGAIN)")
+        p = FakeProc()
+        spawned.append(p)
+        return p
+
+    monkeypatch.setattr(launch.subprocess, "Popen", fake_popen)
+    with pytest.raises(OSError, match="fork failed"):
+        launch._spawn_ranks(3, ["--workload", "digits"], str(tmp_path))
+    assert len(spawned) == 1 and spawned[0].killed
+    # rank 0's log handles were closed, rank 1's never leaked open
+    import gc
+    gc.collect()
+    for name in ("rank0.out", "rank0.err", "rank1.out", "rank1.err"):
+        p = tmp_path / name
+        if p.exists():
+            # reopening for write would fail on a leaked exclusive
+            # handle only on some platforms; instead verify no open fd
+            # points at it via /proc/self/fd
+            fds = []
+            for fd in os.listdir("/proc/self/fd"):
+                try:
+                    fds.append(os.readlink(f"/proc/self/fd/{fd}"))
+                except OSError:
+                    pass
+            assert str(p) not in fds, f"leaked open handle for {name}"

@@ -126,3 +126,18 @@ def test_null_logger_log_path_is_sink_free(monkeypatch):
     m.count_failure("timeout")
     s = m.summary()
     assert s["trials_timeout"] == 1
+
+
+def test_summary_includes_staging_counters():
+    """staged_bytes / stage_overlap_s (wave-scheduled fused sweeps)
+    reach the metrics summary; zero-valued when no staging ran."""
+    from mpi_opt_tpu.utils.metrics import MetricsLogger
+
+    m = MetricsLogger()
+    m.count_staging(1024, 0.5)
+    m.count_staging(1024, 0.25)
+    s = m.summary()
+    assert s["staged_bytes"] == 2048
+    assert s["stage_overlap_s"] == 0.75
+    z = MetricsLogger().summary()
+    assert z["staged_bytes"] == 0 and z["stage_overlap_s"] == 0.0

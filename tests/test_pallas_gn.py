@@ -85,3 +85,15 @@ def test_bf16_activation_dtype_roundtrip():
     np.testing.assert_allclose(
         np.asarray(y, np.float32), np.asarray(yr, np.float32), atol=3e-2
     )
+
+
+def test_non_dividing_group_count_raises():
+    """C % num_groups != 0 must raise (flax parity): _group_matrices
+    floor-divides, so a non-dividing count would silently normalize over
+    a WRONG group membership instead of failing."""
+    x, gamma, beta, _ = _setup(48, 32)
+    with pytest.raises(ValueError, match="divisible"):
+        pg.group_norm_relu(x, gamma, beta, groups=32)
+    # the gradient path funnels through the same forward check
+    with pytest.raises(ValueError, match="divisible"):
+        jax.grad(lambda v: pg.group_norm_relu(v, gamma, beta, groups=5).sum())(x)
