@@ -22,8 +22,41 @@ from mpi_opt_tpu.health import EX_TEMPFAIL, SweepInterrupted
 from mpi_opt_tpu.health import heartbeat as _heartbeat
 from mpi_opt_tpu.health import shutdown as _shutdown
 from mpi_opt_tpu.ops.pbt import PBTConfig
+from mpi_opt_tpu.utils import integrity
+from mpi_opt_tpu.utils.integrity import EX_DATAERR, NoVerifiedSnapshotError
 from mpi_opt_tpu.utils.metrics import stdout_logger
 from mpi_opt_tpu.workloads import available, get_workload
+
+
+def _wire_integrity_observer(metrics):
+    """Route snapshot-corruption events (utils/integrity.py) into this
+    run's metrics stream: each ``snapshot_corrupt`` becomes a logged
+    event plus one tick of the ``snapshots_quarantined`` counter. The
+    observer is process-global (fused trainers build checkpointers deep
+    inside the sweep, far from any metrics handle); main() clears it on
+    the way out so in-process callers see no residue."""
+
+    def observe(event, **fields):
+        metrics.log(event, **fields)
+        if event == "snapshot_corrupt":
+            metrics.count_quarantined()
+
+    integrity.set_observer(observe)
+
+
+def _data_error_exit(e, metrics, **summary_fields) -> int:
+    """The corruption-dead-end exit: no verified snapshot remains, so a
+    retry would re-read the same poisoned state. Summarize, print the
+    single-JSON-line shape, and exit EX_DATAERR (65) — the code
+    launch.py classifies as NON-retryable (abort with diagnostics
+    instead of burning the restart budget)."""
+    metrics.summary(final=True)
+    print(json.dumps({"data_error": str(e), **summary_fields}))
+    print(
+        f"{e}\n(no retry can help: exit {EX_DATAERR})",
+        file=sys.stderr,
+    )
+    return EX_DATAERR
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -512,9 +545,18 @@ def run_fused(args, parser, workload) -> int:
     # local divisor would overstate per-chip throughput by the host count.
     n_chips = int(mesh.devices.size) if mesh is not None else 1
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
+    _wire_integrity_observer(metrics)
     t0 = time.perf_counter()
     try:
         return _run_fused_dispatch(args, parser, workload, mesh, n_chips, metrics, t0)
+    except NoVerifiedSnapshotError as e:
+        return _data_error_exit(
+            e,
+            metrics,
+            workload=args.workload,
+            algorithm=args.algorithm,
+            backend="fused",
+        )
     except SweepInterrupted as e:
         # graceful preemption: the drained launch's snapshot is flushed
         # (fused trainers force an off-cadence save before raising);
@@ -709,6 +751,13 @@ def main(argv=None) -> int:
         from mpi_opt_tpu.ledger.report import report_main
 
         return report_main(argv[1:])
+    # `mpi_opt_tpu fsck DIR` audits a sweep's durable snapshot state
+    # (verify manifests, surface torn saves, --repair quarantines) —
+    # same subcommand surface as report, see utils/integrity.py
+    if argv and argv[0] == "fsck":
+        from mpi_opt_tpu.utils.integrity import fsck_main
+
+        return fsck_main(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.resume and not (args.checkpoint_dir or args.ledger):
@@ -834,6 +883,7 @@ def main(argv=None) -> int:
             return _run_sweep(args, parser)
     finally:
         _heartbeat.deconfigure()
+        integrity.clear_observer()
 
 
 def _run_sweep(args, parser) -> int:
@@ -889,6 +939,7 @@ def _run_sweep(args, parser) -> int:
     if args.backend == "tpu" and mesh is not None:
         n_chips = int(mesh.devices.size)
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
+    _wire_integrity_observer(metrics)
     checkpointer = None
     restored_step = None
     if args.checkpoint_dir:
@@ -896,7 +947,21 @@ def _run_sweep(args, parser) -> int:
 
         checkpointer = SearchCheckpointer(args.checkpoint_dir, every=args.checkpoint_every)
         if args.resume:
-            restored_step = checkpointer.restore_into(algorithm, backend)
+            try:
+                restored_step = checkpointer.restore_into(algorithm, backend)
+            except NoVerifiedSnapshotError as e:
+                # every retained step failed verification: a retry (or a
+                # supervisor's --resume restart) would re-read the same
+                # poisoned state — abort with the distinct data-error code
+                checkpointer.close()
+                backend.close()
+                return _data_error_exit(
+                    e,
+                    metrics,
+                    workload=args.workload,
+                    algorithm=args.algorithm,
+                    backend=args.backend,
+                )
             metrics.log("resume", step=restored_step)
     from mpi_opt_tpu.driver import FailurePolicy, SweepAborted
     from mpi_opt_tpu.utils.profiling import profile_window

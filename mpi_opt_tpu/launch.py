@@ -43,6 +43,20 @@ matrix):
   polling can never see. The job is killed and coordinate-restarted,
   consuming one retry.
 
+Two non-retryable classifications cut restart storms short:
+
+- DATA ERROR (exit 65 = EX_DATAERR): the rank's resume found snapshots
+  but NONE verified (utils/integrity.py quarantined every retained
+  step). Restarting re-reads the same poisoned state — abort with
+  diagnostics immediately instead of burning the whole retries/
+  preemption budget on a crash loop. (Exit 2, a usage error, is
+  refused for the analogous reason — see below.)
+- CRASH LOOP (``--crash-loop-threshold``/``--crash-loop-window``): N
+  consecutive failure restarts where each attempt died within the
+  window are a deterministic bug regardless of exit code — abort even
+  while ``--retries`` budget remains, so a large budget sized for rare
+  platform deaths can't be burned in seconds.
+
 Escalation is always graceful-first: survivors/stragglers get SIGTERM
 (their own drain handlers flush state) and only after ``--term-grace``
 seconds SIGKILL.
@@ -67,6 +81,7 @@ import time
 
 from mpi_opt_tpu.health.shutdown import EX_TEMPFAIL, ShutdownGuard
 from mpi_opt_tpu.health.watchdog import StallDetector
+from mpi_opt_tpu.utils.integrity import EX_DATAERR
 
 
 def _backoff_s(attempt: int, base: float, jitter: float, rng: random.Random) -> float:
@@ -284,6 +299,24 @@ def main(argv=None) -> int:
         "forever just because preemptions don't bill --retries",
     )
     parser.add_argument(
+        "--crash-loop-threshold",
+        type=int,
+        default=3,
+        metavar="N",
+        help="abort after N CONSECUTIVE failure restarts whose attempts "
+        "each died within --crash-loop-window seconds (0 disables): a "
+        "job failing that fast is a deterministic bug, not platform "
+        "weather, and must not grind through a large --retries budget",
+    )
+    parser.add_argument(
+        "--crash-loop-window",
+        type=float,
+        default=30.0,
+        metavar="SECONDS",
+        help="an attempt shorter than this counts toward the crash-loop "
+        "threshold; attempts that lived longer reset the streak",
+    )
+    parser.add_argument(
         "rest",
         nargs=argparse.REMAINDER,
         help="-- followed by the mpi_opt_tpu CLI arguments for every rank",
@@ -306,6 +339,14 @@ def main(argv=None) -> int:
         )
     if args.term_grace < 0:
         parser.error(f"--term-grace must be >= 0, got {args.term_grace}")
+    if args.crash_loop_threshold < 0:
+        parser.error(
+            f"--crash-loop-threshold must be >= 0, got {args.crash_loop_threshold}"
+        )
+    if args.crash_loop_window <= 0:
+        parser.error(
+            f"--crash-loop-window must be > 0, got {args.crash_loop_window}"
+        )
     # argparse accepts both '--flag value' and '--flag=value'; match
     # flags by token prefix so the '=' spelling can't slip through the
     # ownership guard (or, below, defeat the --resume recovery append)
@@ -338,9 +379,37 @@ def main(argv=None) -> int:
     preemptions = 0  # free restarts consumed (vs --max-preemptions)
     stalls = 0
     relaunches = 0
+    fast_fails = 0  # consecutive failures quicker than --crash-loop-window
 
     def _event(name, **fields):
         print(json.dumps({"event": name, **fields}), flush=True)
+
+    def _crash_looping(attempt_wall: float) -> bool:
+        """Account one failure outcome; True when the consecutive
+        fast-failure streak hits the breaker threshold."""
+        nonlocal fast_fails
+        if attempt_wall < args.crash_loop_window:
+            fast_fails += 1
+        else:
+            fast_fails = 0
+        return 0 < args.crash_loop_threshold <= fast_fails
+
+    def _crash_loop_abort(detail: str, **event_fields) -> int:
+        """The breaker's one abort surface (shared by the stall and
+        rank-exit paths): failed event + diagnostics, rc 1."""
+        _event(
+            "failed",
+            crash_loop=True,
+            consecutive_fast_failures=fast_fails,
+            window_s=args.crash_loop_window,
+            **event_fields,
+        )
+        sys.stderr.write(
+            f"crash loop: {fast_fails} consecutive failures, each within "
+            f"{args.crash_loop_window}s of launch ({detail}); aborting "
+            "instead of burning the restart budget.\n"
+        )
+        return 1
 
     with ShutdownGuard() as guard:
         while True:
@@ -374,10 +443,12 @@ def main(argv=None) -> int:
                     [_hb_path(log_dir, i) for i in range(args.n_proc)],
                     args.stall_timeout,
                 )
+            t_attempt = time.monotonic()
             procs = _spawn_ranks(args.n_proc, rank_args, log_dir, heartbeat=watch_stalls)
             kind, info = _watch(
                 procs, args.poll_interval, args.term_grace, detector, guard
             )
+            attempt_wall = time.monotonic() - t_attempt
             if kind == "done":
                 # success: re-surface rank 0's summary line as our own
                 # (scan for the summary-JSON shape — trailing
@@ -424,6 +495,10 @@ def main(argv=None) -> int:
                         f"{args.stall_timeout}s); retries exhausted.\n"
                     )
                     return 1
+                if _crash_looping(attempt_wall):
+                    return _crash_loop_abort(
+                        f"last: ranks {info} stalled", stalled_ranks=info
+                    )
                 attempt += 1
                 delay = _backoff_s(attempt, args.restart_backoff, 0.5, backoff_rng)
                 relaunches += 1
@@ -447,6 +522,7 @@ def main(argv=None) -> int:
                 # flushed before exiting. A coordinated resume costs the
                 # platform nothing it hadn't already decided to spend —
                 # so it does NOT consume the failure --retries budget.
+                fast_fails = 0  # a drain is progress, not a crash loop
                 preemptions += 1
                 if preemptions > args.max_preemptions:
                     _event(
@@ -478,6 +554,29 @@ def main(argv=None) -> int:
                 if delay > 0:
                     time.sleep(delay)
                 continue
+            if rc == EX_DATAERR:
+                # snapshot-corruption dead end (utils/integrity.py): the
+                # rank's resume found steps but every one failed
+                # verification and was quarantined. A restart's --resume
+                # re-reads the same poisoned directory — the exact
+                # restart storm this supervisor must NOT fund. Abort
+                # with diagnostics, budget untouched.
+                _event(
+                    "failed",
+                    rank=failed,
+                    returncode=rc,
+                    attempts=attempt + 1,
+                    data_error=True,
+                )
+                sys.stderr.write(
+                    f"rank {failed} exited {EX_DATAERR} (EX_DATAERR): no "
+                    "verified snapshot remains in its checkpoint "
+                    "directory; not retrying a data error — run "
+                    "`mpi_opt_tpu fsck` on the checkpoint dir, then "
+                    "restart without --resume or point at fresh state. "
+                    f"Stderr:\n{tail}\n"
+                )
+                return 1
             if rc == 2:
                 # argparse usage error: deterministic, and retrying would be
                 # actively wrong — e.g. the CLI's stale-checkpoint-dir
@@ -510,6 +609,11 @@ def main(argv=None) -> int:
                     f"Last stderr:\n{tail}\n"
                 )
                 return 1
+            if _crash_looping(attempt_wall):
+                sys.stderr.write(f"last rank stderr:\n{tail}\n")
+                return _crash_loop_abort(
+                    f"last: rank {failed} rc={rc}", rank=failed, returncode=rc
+                )
             attempt += 1
             delay = _backoff_s(attempt, args.restart_backoff, 0.5, backoff_rng)
             relaunches += 1

@@ -132,6 +132,40 @@ def _render_text(rep: dict) -> str:
     return "\n".join(lines)
 
 
+def replay_consistency(ledger_path: str, search_state: dict) -> list:
+    """Cross-check a ledger journal against a restored snapshot's
+    ``search`` item (fsck's replay-consistency gate): every trial the
+    snapshot records as FINAL must hold a final record in the journal,
+    because the driver fsyncs each record BEFORE reporting it to the
+    algorithm — the journal can never lag the search state. A snapshot
+    final missing from the journal means the pair is torn (mixed
+    directories, a hand-edited journal) and a ``--ledger --resume``
+    would replay into a state that is already ahead of it.
+
+    Returns human-readable problems (empty = consistent).
+    """
+    try:
+        _header, records, _n_torn = read_ledger(ledger_path)
+    except (LedgerError, OSError) as e:
+        return [f"ledger unreadable for cross-check: {e}"]
+    journaled = {int(r["trial_id"]) for r in records}
+    finals = {
+        int(t["trial_id"])
+        for t in search_state.get("algorithm", {}).get("trials", [])
+        # 'done'/'failed' are terminal; 'stopped' (ASHA cut) trials also
+        # completed an evaluation and were journaled before the cut
+        if t.get("status") in ("done", "failed", "stopped")
+    }
+    missing = sorted(finals - journaled)
+    if missing:
+        return [
+            f"snapshot records {len(missing)} final trial(s) absent from "
+            f"the journal (trial ids {missing[:10]}"
+            + ("...)" if len(missing) > 10 else ")")
+        ]
+    return []
+
+
 def report_main(argv=None) -> int:
     """The ``mpi_opt_tpu report`` subcommand (see cli.main dispatch)."""
     import argparse

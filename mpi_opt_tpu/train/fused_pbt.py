@@ -446,6 +446,17 @@ def _fused_pbt_waves(
                     sample_x=train_x[:2],
                 )
                 wave_scores[w] = sc
+                # per-wave liveness (ROADMAP follow-up): beat as soon as
+                # the wave's programs are dispatched, so a stall timeout
+                # sized to one wave also covers the generation's LAST
+                # wave (whose next boundary beat waits on the full drain
+                # + exploit)
+                from mpi_opt_tpu.health import heartbeat
+
+                heartbeat.beat(
+                    stage=f"pbt gen {g + 1}/{generations} wave "
+                    f"{w + 1}/{n_waves} dispatched"
+                )
                 # async stage-out: the background fetch blocks on THIS
                 # wave's compute while the loop dispatches the next wave
                 engine.stage_out(
@@ -616,6 +627,8 @@ def _run_stepped_generation(
     scan threads one key through all ``steps``. Return shapes match one
     ``run_fused_pbt(generations=1)`` launch.
     """
+    from mpi_opt_tpu.health import heartbeat
+
     key, k_train, k_pbt = jax.random.split(key, 3)
     hp = hparams_fn(unit)
     sub_lens = _balanced_split(steps, step_chunk)
@@ -623,6 +636,10 @@ def _run_stepped_generation(
         state, _ = trainer.train_segment(
             state, hp, train_x, train_y, jax.random.fold_in(k_train, i), s
         )
+        # sub-launch liveness (ROADMAP follow-up): each train sub-segment
+        # beats, so launch.py's --stall-timeout can be sized to one
+        # step_chunk instead of a whole generation's train_segment scan
+        heartbeat.beat(stage=f"pbt train sub-launch {i + 1}/{len(sub_lens)}")
     state, unit, best, mean, n_fail, gen_scores = finish_generation(
         trainer, state, unit, k_pbt, val_x, val_y, discrete_mask=disc, cfg=cfg
     )

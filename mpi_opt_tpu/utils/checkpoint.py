@@ -18,6 +18,16 @@ Layout: one orbax ``CheckpointManager`` step per completed driver batch,
 Saves are asynchronous (orbax's background thread) so the driver loop
 is never blocked on serialization of a multi-GB pool; ``close()`` (or
 the context manager) drains pending writes.
+
+Integrity (utils/integrity.py): every save writes a ``manifest`` item
+with per-item content digests; restore verifies digests BEFORE any
+state is applied, quarantines a failing step (rename to
+``<step>.corrupt``) and walks back to the newest older retained step —
+``keep`` is therefore the fallback budget (default 3: the latest may be
+torn by a SIGKILL mid-async-save, leaving two verified fallbacks). Only
+when no verified step remains does restore raise
+``NoVerifiedSnapshotError`` (the CLI exits EX_DATAERR=65, which the
+launch supervisor treats as non-retryable).
 """
 
 from __future__ import annotations
@@ -27,11 +37,95 @@ from typing import Any, Optional
 
 import orbax.checkpoint as ocp
 
+from mpi_opt_tpu.utils import integrity
+
+
+def _step_item_names(mgr, directory: str, step: int) -> set:
+    """Item names present in a snapshot step, via the manager's
+    metadata probe with a directory-listing fallback (see the warning
+    rationale in SearchCheckpointer._item_names)."""
+    try:
+        meta = mgr.item_metadata(step)
+        names = set(meta.keys()) if hasattr(meta, "keys") else set()
+        if names:
+            return names
+    except Exception as e:
+        import warnings
+
+        warnings.warn(
+            f"checkpoint metadata probe failed at step {step} "
+            f"({type(e).__name__}: {e}); falling back to directory "
+            "listing to detect snapshot items",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+    step_dir = os.path.join(directory, str(step))
+    return set(os.listdir(step_dir)) if os.path.isdir(step_dir) else set()
+
+
+def _restore_walk(mgr, directory: str, attempt):
+    """Last-good-fallback restore: try retained steps newest-first via
+    ``attempt(step)``; a step that fails decode or digest verification
+    is QUARANTINED (renamed, never deleted) and the walk continues on
+    the next older step. Returns ``(step, attempt_result)``, or None
+    when the directory holds no steps at all (caller starts fresh).
+    Raises NoVerifiedSnapshotError when steps existed but every one was
+    quarantined — restarting cannot help, the caller must abort loudly.
+
+    OSError is NOT corruption evidence: an I/O blip (EIO, NFS timeout,
+    permission) says the *filesystem* is sick, not the bytes — it gets
+    one retry, and a persistent OSError re-raises RAW so an intact
+    checkpoint tree is never renamed away for a transient outage.
+    (A SIGKILL-torn step surfaces as a decode/digest failure, not an
+    OSError: its files are short or mangled, not unreadable; an
+    UNcommitted torn step is invisible to orbax here and handled by
+    ``fsck``.)"""
+    quarantined: list = []
+    had_any = False
+    while True:
+        # a fresh manager reflects disk; after each quarantine rename
+        # the reload() below refreshes the step list
+        steps = sorted(mgr.all_steps(), reverse=True)
+        if not steps:
+            break
+        had_any = True
+        step = steps[0]
+        retried_io = False
+        while True:
+            try:
+                return step, attempt(step)
+            except OSError as e:
+                if retried_io:
+                    raise  # persistent I/O failure: not corruption
+                retried_io = True
+                integrity.notify(
+                    "snapshot_io_retry",
+                    step=step,
+                    directory=directory,
+                    error=f"{type(e).__name__}: {e}"[:500],
+                )
+                continue
+            except Exception as e:
+                q = integrity.quarantine_step(directory, step)
+                quarantined.append(q or os.path.join(directory, str(step)))
+                integrity.notify(
+                    "snapshot_corrupt",
+                    step=step,
+                    directory=directory,
+                    error=f"{type(e).__name__}: {e}"[:500],
+                    quarantined_to=None if q is None else os.path.basename(q),
+                )
+                mgr.reload()  # forget the renamed step
+                break
+    if had_any or quarantined:
+        raise integrity.NoVerifiedSnapshotError(directory, quarantined)
+    return None
+
 
 class SearchCheckpointer:
     """Periodic durable snapshots of (algorithm, backend) state."""
 
-    def __init__(self, directory: str, every: int = 1, keep: int = 2):
+    def __init__(self, directory: str, every: int = 1, keep: int = 3):
         if every < 1:
             raise ValueError(f"checkpoint every must be >= 1, got {every}")
         self.directory = os.path.abspath(directory)
@@ -56,9 +150,16 @@ class SearchCheckpointer:
             "backend": backend.host_state_dict(),
         }
         items = {"search": ocp.args.JsonSave(search)}
+        tree_items = {}
         pool = backend.device_state()
         if pool is not None:
             items["pool"] = ocp.args.StandardSave(pool)
+            tree_items["pool"] = pool
+        # verified save: per-item content digests ride inside the step
+        # (digesting a device pool costs one sync host fetch — the price
+        # of restore being able to prove the bytes survived)
+        manifest = integrity.build_manifest({"search": search}, tree_items)
+        items[integrity.MANIFEST_ITEM] = ocp.args.JsonSave(manifest)
         self._mgr.save(step, args=ocp.args.Composite(**items))
 
     # -- restore -----------------------------------------------------------
@@ -67,19 +168,51 @@ class SearchCheckpointer:
         return self._mgr.latest_step()
 
     def restore_into(self, algorithm, backend) -> Optional[int]:
-        """Load the latest snapshot into a fresh algorithm/backend pair.
+        """Load the newest VERIFIED snapshot into a fresh algorithm/
+        backend pair, quarantining corrupt steps and walking back (see
+        ``_restore_walk``). Restore and digest-verify complete before
+        the first mutation, so a corrupt ``pool`` item can never leave
+        a half-loaded algorithm behind.
 
         Returns the restored step, or None if the directory holds no
-        checkpoint (caller starts fresh).
+        checkpoint (caller starts fresh). Raises NoVerifiedSnapshotError
+        when steps exist but none verifies.
         """
-        step = self._mgr.latest_step()
-        if step is None:
+
+        def attempt(step):
+            items: dict[str, Any] = {"search": ocp.args.JsonRestore()}
+            names = self._item_names(step)
+            has_pool = "pool" in names
+            if has_pool:
+                items["pool"] = ocp.args.StandardRestore()
+            has_manifest = integrity.MANIFEST_ITEM in names
+            if has_manifest:
+                items[integrity.MANIFEST_ITEM] = ocp.args.JsonRestore()
+            r = self._mgr.restore(step, args=ocp.args.Composite(**items))
+            if has_manifest:
+                problems = integrity.verify_restored(
+                    getattr(r, integrity.MANIFEST_ITEM),
+                    {"search": r.search},
+                    {"pool": r.pool} if has_pool else {},
+                )
+                if problems:
+                    raise integrity.SnapshotCorruptError("; ".join(problems))
+            else:
+                # pre-manifest step: resumable (same rule as config keys
+                # added after a snapshot format existed) but announced
+                integrity.notify(
+                    "snapshot_unverified", step=step, directory=self.directory
+                )
+            return r, has_pool
+
+        res = _restore_walk(self._mgr, self.directory, attempt)
+        if res is None:
             return None
-        items: dict[str, Any] = {"search": ocp.args.JsonRestore()}
-        has_pool = "pool" in self._item_names(step)
-        if has_pool:
-            items["pool"] = ocp.args.StandardRestore()
-        r = self._mgr.restore(step, args=ocp.args.Composite(**items))
+        step, (r, has_pool) = res
+        # apply phase: everything above is decoded temporaries — a
+        # failure from here is schema/config drift in live code, not
+        # snapshot corruption, and must surface raw (quarantining a
+        # good snapshot for a program bug would destroy the evidence)
         algorithm.load_state_dict(r.search["algorithm"])
         backend.load_host_state_dict(r.search["backend"])
         if has_pool:
@@ -87,27 +220,11 @@ class SearchCheckpointer:
         return step
 
     def _item_names(self, step: int) -> set:
-        try:
-            meta = self._mgr.item_metadata(step)
-            return set(meta.keys()) if hasattr(meta, "keys") else set()
-        except Exception as e:
-            # the metadata probe is best-effort, but a silent blanket
-            # swallow would hide an orbax API break indefinitely:
-            # surface what failed (type + step) before falling back, so
-            # a probe that is ALWAYS failing is visible instead of
-            # quietly degrading every restore to the weaker directory
-            # heuristic
-            import warnings
-
-            warnings.warn(
-                f"checkpoint metadata probe failed at step {step} "
-                f"({type(e).__name__}: {e}); falling back to directory "
-                "listing to detect snapshot items",
-                RuntimeWarning,
-                stacklevel=2,
-            )
-            step_dir = os.path.join(self.directory, str(step))
-            return set(os.listdir(step_dir)) if os.path.isdir(step_dir) else set()
+        # the metadata probe is best-effort, but a silent blanket
+        # swallow would hide an orbax API break indefinitely:
+        # _step_item_names surfaces what failed (type + step) before
+        # falling back to the weaker directory-listing heuristic
+        return _step_item_names(self._mgr, self.directory, step)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -137,34 +254,72 @@ class SweepCheckpointer:
       sweep shape raises instead of silently loading.
     """
 
-    def __init__(self, directory: str, config: dict, keep: int = 2):
+    def __init__(self, directory: str, config: dict, keep: int = 3):
         self.config = config
+        self.directory = os.path.abspath(directory)
         self._mgr = ocp.CheckpointManager(
-            os.path.abspath(directory),
+            self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=keep, create=True),
         )
 
     def save(self, step: int, sweep: dict, meta_extra: dict) -> None:
         meta = {"config": self.config, **meta_extra}
+        # verified save: both items' content digests ride with the step
+        # (sweep arrays are host-fetched by every caller, so digesting
+        # costs hashing only, no extra device fetch)
+        manifest = integrity.build_manifest({"meta": meta}, {"sweep": sweep})
         self._mgr.save(
             step,
             args=ocp.args.Composite(
-                sweep=ocp.args.StandardSave(sweep), meta=ocp.args.JsonSave(meta)
+                sweep=ocp.args.StandardSave(sweep),
+                meta=ocp.args.JsonSave(meta),
+                **{integrity.MANIFEST_ITEM: ocp.args.JsonSave(manifest)},
             ),
         )
 
     def restore(self):
-        """(sweep_arrays, meta) from the latest snapshot, or None.
-        Raises ValueError on a config mismatch."""
-        step = self._mgr.latest_step()
-        if step is None:
+        """(sweep_arrays, meta) from the newest VERIFIED snapshot, or
+        None when the directory holds no steps. A step failing digest
+        verification or decode is quarantined (``<step>.corrupt``) and
+        restore walks back to the next older retained step; when no
+        verified step remains, NoVerifiedSnapshotError. Raises
+        ValueError on a config mismatch."""
+
+        def attempt(step):
+            items = {
+                "sweep": ocp.args.StandardRestore(),
+                "meta": ocp.args.JsonRestore(),
+            }
+            names = _step_item_names(self._mgr, self.directory, step)
+            has_manifest = integrity.MANIFEST_ITEM in names
+            if has_manifest:
+                items[integrity.MANIFEST_ITEM] = ocp.args.JsonRestore()
+            r = self._mgr.restore(step, args=ocp.args.Composite(**items))
+            if has_manifest:
+                problems = integrity.verify_restored(
+                    getattr(r, integrity.MANIFEST_ITEM),
+                    {"meta": r.meta},
+                    {"sweep": r.sweep},
+                )
+                if problems:
+                    raise integrity.SnapshotCorruptError("; ".join(problems))
+            else:
+                integrity.notify(
+                    "snapshot_unverified", step=step, directory=self.directory
+                )
+            return r
+
+        try:
+            res = _restore_walk(self._mgr, self.directory, attempt)
+        except integrity.NoVerifiedSnapshotError:
+            # same contract as the config-mismatch raise below: callers
+            # only reach their own close() via try/finally blocks
+            # entered AFTER a successful restore
+            self.close()
+            raise
+        if res is None:
             return None
-        r = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                sweep=ocp.args.StandardRestore(), meta=ocp.args.JsonRestore()
-            ),
-        )
+        _step, r = res
         saved = dict(r.meta["config"])
         # config keys added AFTER a snapshot format existed compare
         # against their historical default, so genuine pre-upgrade
@@ -180,12 +335,22 @@ class SweepCheckpointer:
         if "wave_size" in self.config:
             saved.setdefault("wave_size", 0)  # pre-upgrade sweeps were resident
         if saved != self.config:
+            # name ONLY the mismatched keys: dumping two full config
+            # dicts buries the one line that matters (wave_size vs
+            # resident cross-resume is the common case and should read
+            # as exactly that)
+            diffs = [
+                f"{k}: snapshot={saved.get(k, '<absent>')!r} vs "
+                f"run={self.config.get(k, '<absent>')!r}"
+                for k in sorted(set(saved) | set(self.config), key=str)
+                if saved.get(k, "<absent>") != self.config.get(k, "<absent>")
+            ]
             # close before raising: callers only reach their own close()
             # via try/finally blocks entered AFTER a successful restore
             self.close()
             raise ValueError(
-                "checkpoint directory holds a different sweep: "
-                f"saved config {saved} vs requested {self.config}"
+                "checkpoint directory holds a different sweep "
+                f"(mismatched {'; '.join(diffs)})"
             )
         return r.sweep, r.meta
 

@@ -40,6 +40,10 @@ class MetricsLogger:
         # kills appear in the supervisor's stall/done/failed events)
         self.preempted = 0
         self.stalls_detected = 0
+        # integrity-layer counter (utils/integrity.py): snapshot steps
+        # that failed digest/decode verification on restore and were
+        # quarantined (renamed <step>.corrupt) before last-good fallback
+        self.snapshots_quarantined = 0
         # staging-layer counters (train/staging.py, wave-scheduled fused
         # sweeps): staged_bytes counts host<->device bytes moved by the
         # background transfer engine; stage_overlap_s is how much of the
@@ -97,6 +101,10 @@ class MetricsLogger:
         """Stalled (hung-but-alive) executions detected and killed."""
         self.stalls_detected += n
 
+    def count_quarantined(self, n: int = 1):
+        """Corrupt snapshot steps quarantined during restore."""
+        self.snapshots_quarantined += n
+
     def count_staging(self, staged_bytes: int = 0, overlap_s: float = 0.0):
         """Host-staging traffic from a wave-scheduled fused sweep."""
         self.staged_bytes += int(staged_bytes)
@@ -120,6 +128,7 @@ class MetricsLogger:
             replayed=self.replayed,
             preempted=self.preempted,
             stalls_detected=self.stalls_detected,
+            snapshots_quarantined=self.snapshots_quarantined,
             staged_bytes=self.staged_bytes,
             stage_overlap_s=round(self.stage_overlap_s, 3),
             wall_s=round(self.wall, 3),

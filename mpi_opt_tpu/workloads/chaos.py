@@ -92,6 +92,94 @@ def parse_chaos_spec(spec: str) -> dict:
     return out
 
 
+# -- snapshot-corruption injectors (torn_save / corrupt_save faults) --------
+#
+# The per-trial faults above exercise the TRIAL failure layer; these two
+# exercise the SNAPSHOT integrity layer (utils/integrity.py): what a
+# SIGKILL mid-async-save (torn_save) or silent bit-rot (corrupt_save)
+# leaves inside the latest orbax step directory. They are direct-call
+# helpers, not probability faults — corruption strikes the durable
+# state between runs, not an evaluation — and deterministic given
+# (directory contents, seed) so resume drills can pin exact outcomes.
+
+
+def _committed_step_dirs(checkpoint_dir: str) -> list:
+    """(step, path) for every committed orbax step under
+    ``checkpoint_dir`` (recursive: hyperband nests per-bracket roots).
+    Enumeration is delegated to utils.integrity so the injectors strike
+    exactly the steps fsck audits — one home for the orbax commit-marker
+    convention."""
+    from mpi_opt_tpu.utils.integrity import _committed_steps, find_checkpoint_roots
+
+    out = []
+    for root in find_checkpoint_roots(checkpoint_dir):
+        out.extend(
+            (s, os.path.join(root, str(s))) for s in _committed_steps(root)
+        )
+    return sorted(out)
+
+
+def _corruption_target(step_dir: str) -> str:
+    """The file a fault strikes: the LARGEST regular file in the step
+    (ties broken by path) — in any real snapshot that is array data,
+    the payload whose rot matters most; in toy snapshots it may be the
+    manifest itself, which verification must also survive."""
+    candidates = []
+    for root, _dirs, files in os.walk(step_dir):
+        for f in files:
+            p = os.path.join(root, f)
+            candidates.append((os.path.getsize(p), p))
+    if not candidates:
+        raise ValueError(f"no files to corrupt under {step_dir}")
+    # largest first; the path tiebreak keeps the pick stable when sizes
+    # collide (sort ascending, take last => greatest (size, path))
+    return sorted(candidates)[-1][1]
+
+
+def _resolve_step_dir(checkpoint_dir: str, step) -> str:
+    steps = _committed_step_dirs(checkpoint_dir)
+    if not steps:
+        raise ValueError(f"no committed snapshot steps under {checkpoint_dir}")
+    if step is None:
+        return steps[-1][1]
+    for s, path in steps:
+        if s == int(step):
+            return path
+    raise ValueError(f"step {step} not found under {checkpoint_dir}")
+
+
+def inject_torn_save(checkpoint_dir: str, seed: int = 0, step=None) -> str:
+    """Truncate a file inside the latest (or given) committed step dir —
+    the shape a SIGKILL mid-async-save leaves behind. The cut point is a
+    seeded draw over the file's interior so repeated drills vary the
+    tear without losing determinism. Returns the mangled path."""
+    path = _corruption_target(_resolve_step_dir(checkpoint_dir, step))
+    size = os.path.getsize(path)
+    h = hashlib.sha256(f"torn:{seed}".encode()).digest()
+    cut = 1 + int.from_bytes(h[:8], "big") % max(size - 1, 1)
+    with open(path, "r+b") as f:
+        f.truncate(cut)
+    return path
+
+
+def inject_corrupt_save(checkpoint_dir: str, seed: int = 0, step=None) -> str:
+    """Flip one bit inside the latest (or given) committed step dir —
+    the silent bit-rot shape only content digests can catch. Seeded
+    offset/bit, deterministic per (directory contents, seed). Returns
+    the mangled path."""
+    path = _corruption_target(_resolve_step_dir(checkpoint_dir, step))
+    size = os.path.getsize(path)
+    h = hashlib.sha256(f"corrupt:{seed}".encode()).digest()
+    off = int.from_bytes(h[:8], "big") % size
+    bit = h[8] % 8
+    with open(path, "r+b") as f:
+        f.seek(off)
+        byte = f.read(1)
+        f.seek(off)
+        f.write(bytes([byte[0] ^ (1 << bit)]))
+    return path
+
+
 @register
 class ChaosWorkload(Workload):
     name = "chaos"

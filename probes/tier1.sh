@@ -4,10 +4,13 @@
 # Exactly the ROADMAP.md tier-1 command: single-process (-p no:xdist),
 # chaos tests included, slow tests excluded, 870 s budget, with the
 # DOTS_PASSED count extracted from the progress lines (the driver's
-# no-worse-than-seed gate reads it).
+# no-worse-than-seed gate reads it) — followed by the fsck corruption
+# drill: a tiny checkpointed sweep is bit-rotted, `fsck` must flag it
+# (exit 1), and `--repair` + `--resume` must recover (ISSUE 5).
 #
 # Usage: probes/tier1.sh            # run + report
 #        T1_LOG=/tmp/my.log probes/tier1.sh   # custom log path
+#        T1_SKIP_FSCK_DRILL=1 probes/tier1.sh # pytest only
 set -o pipefail
 cd "$(dirname "$0")/.."
 T1_LOG="${T1_LOG:-/tmp/_t1.log}"
@@ -17,4 +20,35 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$T1_LOG"
 rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$T1_LOG" | tr -cd . | wc -c)"
+
+# -- fsck corruption drill (snapshot-integrity layer, utils/integrity.py) --
+if [ -z "$T1_SKIP_FSCK_DRILL" ]; then
+    drill_rc=0
+    D=$(mktemp -d /tmp/_t1_fsck.XXXXXX)
+    run_sweep() {
+        timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+            --workload quadratic --algorithm random --trials 6 --budget 3 \
+            --workers 1 --seed 0 --checkpoint-dir "$D/ck" "$@" >/dev/null 2>&1
+    }
+    fsck() {
+        timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+            fsck "$D/ck" "$@" >/dev/null 2>&1
+    }
+    run_sweep || drill_rc=1
+    fsck || drill_rc=1                      # clean tree must audit clean
+    env JAX_PLATFORMS=cpu python -c \
+        "from mpi_opt_tpu.workloads.chaos import inject_corrupt_save; \
+         inject_corrupt_save('$D/ck')" || drill_rc=1
+    fsck; [ $? -eq 1 ] || drill_rc=1        # corruption must be FLAGGED
+    fsck --repair; [ $? -eq 1 ] || drill_rc=1  # found + repaired contract
+    run_sweep --resume || drill_rc=1        # last-good fallback recovers
+    fsck || drill_rc=1                      # post-recovery tree is clean
+    rm -rf "$D"
+    if [ $drill_rc -eq 0 ]; then
+        echo "FSCK_DRILL=pass"
+    else
+        echo "FSCK_DRILL=FAIL"
+        rc=$(( rc == 0 ? 1 : rc ))
+    fi
+fi
 exit $rc

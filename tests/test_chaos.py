@@ -297,3 +297,77 @@ def test_injected_hang_on_stateful_path_times_out_under_isolation():
     # wall_time excludes worker bring-up (the ready handshake): the
     # reap itself lands within ~2x the deadline
     assert r.wall_time < 2 * 1.5
+
+
+# -- snapshot-corruption injectors (torn_save / corrupt_save) ---------------
+
+
+def _snapshot_dir(tmp_path):
+    """A real 2-step orbax snapshot tree to corrupt."""
+    import numpy as np
+
+    from mpi_opt_tpu.utils.checkpoint import SweepCheckpointer
+
+    d = str(tmp_path / "ck")
+    ck = SweepCheckpointer(d, {"seed": 0, "momentum_dtype": "float32"})
+    for s in (1, 2):
+        ck.save(
+            s,
+            sweep={"state": {"p": np.arange(64, dtype=np.float32) * s}},
+            meta_extra={"gen": s},
+        )
+    ck.close()
+    return d
+
+
+def test_corrupt_save_is_deterministic_and_flips_one_bit(tmp_path):
+    """Same (directory contents, seed) -> same file, same bit: drills
+    that pin exact outcomes stay reproducible across machines."""
+    import os
+
+    from mpi_opt_tpu.workloads import chaos
+
+    d = _snapshot_dir(tmp_path)
+    target = chaos._corruption_target(os.path.join(d, "2"))
+    before = open(target, "rb").read()
+    path = chaos.inject_corrupt_save(d, seed=3)
+    assert path == target  # strikes the latest step's largest file
+    after = open(path, "rb").read()
+    assert len(after) == len(before)
+    diff = [i for i, (a, b) in enumerate(zip(before, after)) if a != b]
+    assert len(diff) == 1  # exactly one byte
+    assert bin(before[diff[0]] ^ after[diff[0]]).count("1") == 1  # one bit
+    # flipping again with the same seed restores the original byte —
+    # the draw is a pure function of (contents, seed)
+    chaos.inject_corrupt_save(d, seed=3)
+    assert open(path, "rb").read() == before
+
+
+def test_torn_save_truncates_inside_the_step(tmp_path):
+    import os
+
+    from mpi_opt_tpu.workloads import chaos
+
+    d = _snapshot_dir(tmp_path)
+    size_before = os.path.getsize(chaos._corruption_target(os.path.join(d, "2")))
+    path = chaos.inject_torn_save(d, seed=0)
+    assert f"{os.sep}2{os.sep}" in path  # the LATEST step, not an older one
+    assert 0 < os.path.getsize(path) < size_before
+
+
+def test_injectors_target_explicit_step_and_refuse_empty_dirs(tmp_path):
+    import os
+
+    import pytest
+
+    from mpi_opt_tpu.workloads import chaos
+
+    d = _snapshot_dir(tmp_path)
+    path = chaos.inject_corrupt_save(d, step=1)
+    assert f"{os.sep}1{os.sep}" in path
+    with pytest.raises(ValueError, match="step 9 not found"):
+        chaos.inject_corrupt_save(d, step=9)
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    with pytest.raises(ValueError, match="no committed snapshot steps"):
+        chaos.inject_torn_save(empty)

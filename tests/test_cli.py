@@ -759,6 +759,94 @@ def test_report_subcommand_text_json_and_validate(capsys, tmp_path):
     capsys.readouterr()
 
 
+def test_cli_fsck_json_schema_repair_resume_cycle(capsys, tmp_path):
+    """`mpi_opt_tpu fsck`: the CI contract mirroring report --validate —
+    exit 0 + ok:true on a clean tree, exit 1 with the corrupt step named
+    after bit-rot, --repair quarantines, --resume recovers via last-good
+    fallback, and the final audit shows the quarantine. This test IS the
+    tier-1 wiring that catches fsck schema drift (probes/tier1.sh runs
+    the same cycle as a shell drill)."""
+    from mpi_opt_tpu.workloads.chaos import inject_corrupt_save
+
+    ck = str(tmp_path / "ck")
+    base = [
+        "--workload", "quadratic", "--algorithm", "random",
+        "--trials", "6", "--budget", "3", "--workers", "1",
+        "--seed", "0", "--checkpoint-dir", ck,
+    ]
+    assert main(base) == 0
+    capsys.readouterr()
+
+    assert main(["fsck", ck, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    # the stable schema fsck's CI consumers key on
+    assert set(rep) >= {
+        "dir", "ok", "steps", "newest_verified", "repaired", "quarantined", "ledger",
+    }
+    assert rep["ok"] is True
+    assert [s["status"] for s in rep["steps"]] == ["verified"] * 3  # keep=3
+    assert rep["newest_verified"]["step"] == 6
+
+    inject_corrupt_save(ck)
+    assert main(["fsck", ck, "--json"]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ok"] is False
+    assert {s["step"]: s["status"] for s in rep["steps"]}[6] == "corrupt"
+
+    assert main(["fsck", ck, "--json", "--repair"]) == 1  # found + repaired
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["repaired"] == ["6.corrupt"]
+
+    # --resume recovers from the prior verified step and completes
+    assert main(base + ["--resume"]) == 0
+    out = capsys.readouterr().out
+    assert '"event": "resume"' in out and '"step": 5' in out
+    s = _summary_from(out)
+    assert s["n_trials"] == 6 and s["best_score"] is not None
+
+    assert main(["fsck", ck, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ok"] is True and rep["quarantined"] == ["6.corrupt"]
+
+
+def test_cli_resume_with_no_verified_snapshot_exits_data_error(capsys, tmp_path):
+    """Every retained step poisoned: --resume must exit the distinct
+    EX_DATAERR (65) — the code launch.py refuses to retry — after
+    quarantining the evidence, and say so on the single-JSON-line
+    contract."""
+    from mpi_opt_tpu.workloads.chaos import (
+        _committed_step_dirs,
+        inject_corrupt_save,
+    )
+
+    ck = str(tmp_path / "ck")
+    base = [
+        "--workload", "quadratic", "--algorithm", "random",
+        "--trials", "4", "--budget", "3", "--workers", "1",
+        "--seed", "0", "--checkpoint-dir", ck,
+    ]
+    assert main(base) == 0
+    capsys.readouterr()
+    poisoned = [step for step, _path in _committed_step_dirs(ck)]
+    for step in poisoned:
+        inject_corrupt_save(ck, step=step)
+    assert len(poisoned) == 3  # keep=3 retained steps, all now bad
+    rc = main(base + ["--resume"])
+    out = capsys.readouterr().out
+    assert rc == 65
+    lines = [json.loads(l) for l in out.splitlines() if l.startswith("{")]
+    data_err = [l for l in lines if "data_error" in l]
+    assert data_err and "no verified snapshot" in data_err[-1]["data_error"]
+    # the corruption events reached the metrics stream with the counter
+    summaries = [l for l in lines if l.get("event") == "summary"]
+    assert summaries[-1]["snapshots_quarantined"] == 3
+    assert sum(1 for l in lines if l.get("event") == "snapshot_corrupt") == 3
+    # quarantines, not deletions
+    assert sorted(d for d in os.listdir(ck) if d.endswith(".corrupt")) == [
+        f"{s}.corrupt" for s in poisoned
+    ]
+
+
 def test_cli_validates_failure_policy_flags(capsys):
     """Bad policy values are usage errors (exit 2 + message), not raw
     ValueError tracebacks from deep inside the run."""

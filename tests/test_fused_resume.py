@@ -96,6 +96,13 @@ def test_pre_upgrade_snapshot_resume_reports_no_launch_walls(tmp_path, monkeypat
                 json.dump(d, f)
             hit += 1
     assert hit, "no snapshot meta JSON found to rewrite"
+    # a genuine pre-upgrade snapshot predates the integrity manifest
+    # too: drop the item, or the (correct!) digest check would flag the
+    # meta edit above as tampering and quarantine the step
+    import shutil
+
+    for mdir in glob.glob(f"{ckpt}/*/manifest"):
+        shutil.rmtree(mdir)
 
     resumed = fp.fused_pbt(wl, checkpoint_dir=ckpt, **KW)
     np.testing.assert_array_equal(resumed["best_curve"], whole["best_curve"])

@@ -195,6 +195,68 @@ def test_wave_preempt_between_waves_resumes_without_retraining(wl, tmp_path):
     assert _tree_equal(resumed["state"].params, whole["state"].params)
 
 
+def test_wave_corrupt_snapshot_falls_back_bit_identical(wl, tmp_path):
+    """The ISSUE-5 acceptance drill for wave sweeps: kill mid-sweep,
+    bit-rot the LATEST snapshot, resume — restore quarantines the bad
+    step (kept as evidence, not deleted), falls back to the previous
+    verified generation boundary, and the finished sweep is still
+    bit-identical to the uninterrupted run; fsck reports the
+    quarantine."""
+    import json
+
+    from mpi_opt_tpu.utils import integrity
+    from mpi_opt_tpu.workloads.chaos import inject_corrupt_save
+
+    whole = fp.fused_pbt(wl, wave_size=3, **KW)
+    real = fp._run_wave
+    calls = {"n": 0}
+
+    def crashing(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 8:  # gens 0,1 = 6 waves; die inside gen 2 —
+            # boundary snapshots for steps 3 (gen 0) AND 6 (gen 1) exist
+            raise RuntimeError("simulated TPU worker crash")
+        return real(*a, **k)
+
+    ckpt = str(tmp_path / "ck")
+    fp._run_wave = crashing
+    try:
+        with pytest.raises(RuntimeError, match="simulated"):
+            fp.fused_pbt(wl, wave_size=3, checkpoint_dir=ckpt, **KW)
+    finally:
+        fp._run_wave = real
+
+    inject_corrupt_save(ckpt)  # bit-rot the latest step (6)
+    events = []
+    integrity.set_observer(lambda event, **f: events.append((event, f)))
+    try:
+        resumed = fp.fused_pbt(wl, wave_size=3, checkpoint_dir=ckpt, **KW)
+    finally:
+        integrity.clear_observer()
+    assert [e for e, _ in events] == [("snapshot_corrupt")]
+    assert events[0][1]["step"] == 6
+    assert os.path.isdir(os.path.join(ckpt, "6.corrupt"))  # quarantined, kept
+    # last-good fallback (gen-0 boundary) + carried-key chain => the
+    # exact result the unkilled sweep produced
+    np.testing.assert_array_equal(resumed["best_curve"], whole["best_curve"])
+    np.testing.assert_array_equal(resumed["unit"], whole["unit"])
+    assert resumed["best_score"] == whole["best_score"]
+    assert resumed["best_params"] == whole["best_params"]
+    assert _tree_equal(resumed["state"].params, whole["state"].params)
+    assert _tree_equal(resumed["state"].momentum, whole["state"].momentum)
+    # fsck: the audit sees the quarantine and a clean remaining tree
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = integrity.fsck_main([ckpt, "--json"])
+    assert rc == 0
+    rep = json.loads(buf.getvalue())
+    assert "6.corrupt" in rep["quarantined"]
+    assert all(s["status"] == "verified" for s in rep["steps"])
+
+
 def test_wave_resume_after_completion_runs_nothing(wl, tmp_path):
     ckpt = str(tmp_path / "ck")
     first = fp.fused_pbt(wl, wave_size=3, checkpoint_dir=ckpt, **KW)

@@ -467,6 +467,93 @@ def test_supervisor_bounds_deterministic_self_preemption(tmp_path, monkeypatch, 
     assert last["event"] == "failed" and last.get("preemption_budget_exhausted")
 
 
+def test_supervisor_aborts_on_data_error_without_retrying(tmp_path, monkeypatch, capsys):
+    """Exit 65 (EX_DATAERR: no verified snapshot remains) is the
+    corruption dead end — every restart's --resume would re-read the
+    same poisoned checkpoint dir. The supervisor must abort immediately
+    with diagnostics, leaving the retry AND preemption budgets
+    untouched."""
+    monkeypatch.setattr(
+        launch, "_spawn_ranks", _fake_spawn_script("raise SystemExit(65)")
+    )
+    rc = launch.main([
+        "--n-proc", "1",
+        "--retries", "5",
+        "--poll-interval", "0.01",
+        "--term-grace", "0.1",
+        "--log-dir", str(tmp_path),
+        "--", "--workload", "quadratic",
+    ])
+    assert rc == 1
+    events = [json.loads(l) for l in capsys.readouterr().out.splitlines() if '"event"' in l]
+    names = [e["event"] for e in events]
+    assert "restart" not in names and "preempt_restart" not in names
+    last = events[-1]
+    assert last["event"] == "failed" and last.get("data_error") is True
+    assert last["returncode"] == 65
+
+
+def test_supervisor_crash_loop_breaker_trips_before_budget(tmp_path, monkeypatch, capsys):
+    """A job failing instantly on every launch is a deterministic bug:
+    the breaker (default 3 consecutive sub-window failures) aborts even
+    though --retries 10 would fund seven more doomed relaunches."""
+    monkeypatch.setattr(
+        launch, "_spawn_ranks", _fake_spawn_script("raise SystemExit(3)")
+    )
+    monkeypatch.setattr(launch.time, "sleep", lambda s: None)
+    rc = launch.main([
+        "--n-proc", "1",
+        "--retries", "10",
+        "--poll-interval", "0.01",
+        "--term-grace", "0.1",
+        "--log-dir", str(tmp_path),
+        "--", "--workload", "quadratic",
+    ])
+    assert rc == 1
+    events = [json.loads(l) for l in capsys.readouterr().out.splitlines() if '"event"' in l]
+    names = [e["event"] for e in events]
+    assert names.count("restart") == 2  # failures 1 and 2 restarted
+    last = events[-1]
+    assert last["event"] == "failed" and last.get("crash_loop") is True
+    assert last["consecutive_fast_failures"] == 3
+
+
+def test_supervisor_crash_loop_breaker_disabled_with_zero_threshold(
+    tmp_path, monkeypatch, capsys
+):
+    """--crash-loop-threshold 0 restores the pure --retries budget."""
+    monkeypatch.setattr(
+        launch, "_spawn_ranks", _fake_spawn_script("raise SystemExit(3)")
+    )
+    monkeypatch.setattr(launch.time, "sleep", lambda s: None)
+    rc = launch.main([
+        "--n-proc", "1",
+        "--retries", "4",
+        "--crash-loop-threshold", "0",
+        "--poll-interval", "0.01",
+        "--term-grace", "0.1",
+        "--log-dir", str(tmp_path),
+        "--", "--workload", "quadratic",
+    ])
+    assert rc == 1
+    events = [json.loads(l) for l in capsys.readouterr().out.splitlines() if '"event"' in l]
+    names = [e["event"] for e in events]
+    assert names.count("restart") == 4  # the full budget ran
+    assert events[-1]["event"] == "failed"
+    assert events[-1].get("crash_loop") is None
+
+
+def test_supervisor_validates_crash_loop_flags(capsys):
+    for argv, msg in (
+        (["--crash-loop-threshold", "-1"], "--crash-loop-threshold must be >= 0"),
+        (["--crash-loop-window", "0"], "--crash-loop-window must be > 0"),
+    ):
+        with pytest.raises(SystemExit) as exc:
+            launch.main(["--n-proc", "1", *argv, "--", "--workload", "quadratic"])
+        assert exc.value.code == 2
+        assert msg in capsys.readouterr().err
+
+
 def test_supervisor_owns_heartbeat_flag(capsys):
     with pytest.raises(SystemExit):
         launch.main(["--n-proc", "1", "--", "--heartbeat-file", "/tmp/x"])

@@ -498,3 +498,38 @@ def test_read_only_ledger_fresh_path_creates_nothing(tmp_path):
     assert ro.completed() == {0: ro.records[0]}
     ro.close()
     assert not os.path.exists(path)
+
+
+def test_replay_consistency_cross_check(tmp_path):
+    """fsck's ledger cross-check: every trial a snapshot's search state
+    records as final must hold a journal record (the driver fsyncs the
+    record BEFORE reporting to the algorithm, so the journal can never
+    lag a snapshot); a missing final means the pair is torn."""
+    from mpi_opt_tpu.ledger.report import replay_consistency
+    from mpi_opt_tpu.ledger.store import SweepLedger
+    from mpi_opt_tpu.trial import TrialResult
+
+    led = str(tmp_path / "sweep.jsonl")
+    with SweepLedger(led) as lg:
+        lg.ensure_header({"algorithm": "random", "seed": 0})
+        for tid in (0, 1, 2):
+            lg.record_trial(
+                TrialResult(trial_id=tid, score=0.5, step=1),
+                {"lr": 0.1},
+            )
+    search = {
+        "algorithm": {
+            "trials": [
+                {"trial_id": 0, "status": "done"},
+                {"trial_id": 1, "status": "failed"},
+                {"trial_id": 3, "status": "running"},  # in-flight: exempt
+            ]
+        }
+    }
+    assert replay_consistency(led, search) == []
+    # a snapshot final with no journal record is flagged
+    search["algorithm"]["trials"].append({"trial_id": 7, "status": "done"})
+    problems = replay_consistency(led, search)
+    assert len(problems) == 1 and "7" in problems[0]
+    # unreadable journal degrades to a problem string, not a crash
+    assert replay_consistency(str(tmp_path / "nope.jsonl"), search)

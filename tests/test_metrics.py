@@ -141,3 +141,15 @@ def test_summary_includes_staging_counters():
     assert s["stage_overlap_s"] == 0.75
     z = MetricsLogger().summary()
     assert z["staged_bytes"] == 0 and z["stage_overlap_s"] == 0.0
+
+
+def test_summary_includes_snapshot_quarantine_counter():
+    """snapshots_quarantined (integrity layer) reaches the summary
+    record operators alarm on — explicit zero when nothing happened."""
+    from mpi_opt_tpu.utils.metrics import MetricsLogger
+
+    m = MetricsLogger()
+    m.count_quarantined()
+    m.count_quarantined(2)
+    assert m.summary()["snapshots_quarantined"] == 3
+    assert MetricsLogger().summary()["snapshots_quarantined"] == 0
