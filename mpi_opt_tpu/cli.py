@@ -82,21 +82,26 @@ def build_parser() -> argparse.ArgumentParser:
         "--ledger",
         default=None,
         metavar="PATH",
-        help="driver path: journal every FINAL trial result to this "
-        "JSONL file (fsync'd per record). With --resume, completed "
-        "records are replayed through the algorithm so a killed driver "
-        "resumes at the exact last completed trial, and an exact-match "
-        "params cache skips re-evaluating recorded-ok points",
+        help="journal every FINAL result to this JSONL file (fsync'd "
+        "per record). Driver path: one record per completed trial; "
+        "--fused: one record per population member at every natural "
+        "boundary (PBT generation, SHA/BOHB rung, TPE batch), written "
+        "before the boundary's snapshot. With --resume, completed "
+        "records are replayed (driver) or verified against the "
+        "re-trained boundaries (fused) so a killed sweep resumes with "
+        "an identical journal, and the driver's exact-match params "
+        "cache skips re-evaluating recorded-ok points",
     )
     p.add_argument(
         "--warm-start",
         default=None,
         metavar="PATH",
-        help="driver path: feed a PRIOR sweep's ledger into this "
-        "algorithm as observations before the search starts (TPE/BOHB "
-        "build surrogate priors; random/asha seed their first "
-        "suggestions with the prior best). The prior must have run over "
-        "the same search space (checked by space hash)",
+        help="feed a PRIOR sweep's ledger into this sweep as "
+        "observations before the search starts (TPE/BOHB build "
+        "surrogate priors — fused TPE pre-fills its on-device ring; "
+        "random/asha/pbt seed with the prior best). CROSS-MODE: a "
+        "fused ledger warm-starts a driver sweep and vice versa; the "
+        "only gate is the space hash",
     )
     # checkpoint/resume (SURVEY.md §2 row 13, §5)
     p.add_argument(
@@ -546,10 +551,34 @@ def run_fused(args, parser, workload) -> int:
     n_chips = int(mesh.devices.size) if mesh is not None else 1
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
     _wire_integrity_observer(metrics)
+    from mpi_opt_tpu.ledger import LedgerError
+
+    space = workload.default_space()
+    # the prior ledger validates BEFORE this run's own ledger header
+    # commits, same rule as the driver path: a typo'd --warm-start must
+    # not be journaled into a fresh ledger's identity
+    warm_obs = None
+    if args.warm_start:
+        from mpi_opt_tpu.ledger.warmstart import load_observations
+
+        try:
+            warm_obs = load_observations(args.warm_start, space)
+        except (LedgerError, OSError) as e:
+            parser.error(f"--warm-start: {e}")
+        metrics.log(
+            "warm_start", path=args.warm_start, observations=len(warm_obs)
+        )
+    ledger = _open_fused_ledger(args, parser, space, metrics)
     t0 = time.perf_counter()
     try:
-        return _run_fused_dispatch(args, parser, workload, mesh, n_chips, metrics, t0)
-    except NoVerifiedSnapshotError as e:
+        return _run_fused_dispatch(
+            args, parser, workload, mesh, n_chips, metrics, t0, ledger, warm_obs
+        )
+    except (NoVerifiedSnapshotError, LedgerError) as e:
+        # both are data dead-ends: an unverifiable snapshot tree, or a
+        # journal that diverges from / lags the sweep it claims to
+        # record — no restart re-reads either into health, so exit 65
+        # (launch.py classifies it as non-retryable)
         return _data_error_exit(
             e,
             metrics,
@@ -582,9 +611,96 @@ def run_fused(args, parser, workload) -> int:
             file=sys.stderr,
         )
         return EX_TEMPFAIL
+    finally:
+        if ledger is not None:
+            ledger.close()
 
 
-def _run_fused_dispatch(args, parser, workload, mesh, n_chips, metrics, t0) -> int:
+def _open_fused_ledger(args, parser, space, metrics):
+    """Open + identity-check the fused sweep's ledger (None without
+    --ledger). Mirrors the driver path's rules — rank-0-only journaling
+    under multi-process SPMD, stale journals need explicit --resume —
+    and commits a FUSED header: ``mode``/``granularity`` mark the
+    boundary-granular record stream, and the config carries everything
+    that shapes the deterministic trajectory the journal will be
+    verified against on resume."""
+    if not args.ledger:
+        return None
+    from mpi_opt_tpu.ledger import LedgerError, SweepLedger
+
+    ledger_rank = 0
+    if args.multihost or args.coordinator is not None:
+        import jax
+
+        ledger_rank = jax.process_index()
+    try:
+        ledger = SweepLedger(args.ledger, read_only=ledger_rank != 0)
+    except LedgerError as e:
+        parser.error(f"--ledger: {e}")
+    if ledger.read_only:
+        metrics.log("ledger_rank_gated", rank=ledger_rank)
+    if ledger.records and not args.resume:
+        parser.error(
+            f"--ledger {args.ledger!r} already holds "
+            f"{len(ledger.records)} member records; pass --resume to "
+            "verify and continue them, or point at a fresh path"
+        )
+    config = {
+        "mode": "fused",
+        "granularity": {"pbt": "generation", "tpe": "batch"}.get(
+            args.algorithm, "rung"
+        ),
+        "algorithm": args.algorithm,
+        "workload": args.workload,
+        "backend": "fused",
+        "seed": args.seed,
+        "space_hash": space.space_hash(),
+        "warm_start": args.warm_start,
+    }
+    # the knobs that shape each algorithm's boundary/member structure
+    if args.algorithm == "pbt":
+        # wave_size is deliberately NOT ledger identity: wave scheduling
+        # is bit-identical to resident mode, so the journal records the
+        # same trajectory either way (snapshots still refuse the
+        # cross-resume — that's state shape, not history)
+        config.update(
+            population=args.population,
+            generations=args.generations,
+            steps_per_generation=args.steps_per_generation,
+        )
+    elif args.algorithm == "tpe":
+        config.update(
+            trials=args.trials, batch=args.population, budget=args.budget
+        )
+    elif args.algorithm == "random":
+        config.update(trials=args.trials, budget=args.budget)
+    elif args.algorithm == "asha":
+        config.update(
+            trials=args.trials,
+            min_budget=args.min_budget,
+            max_budget=args.max_budget,
+            eta=args.eta,
+        )
+    else:  # hyperband / bohb
+        config.update(max_budget=args.max_budget, eta=args.eta)
+    try:
+        ledger.ensure_header(config)
+    except LedgerError as e:
+        parser.error(f"--ledger: {e}")
+    if ledger.n_torn:
+        metrics.log("ledger_torn_tail_dropped", path=args.ledger)
+    if ledger.n_torn_boundary:
+        metrics.log(
+            "ledger_torn_boundary_dropped",
+            path=args.ledger,
+            records=ledger.n_torn_boundary,
+        )
+    return ledger
+
+
+def _run_fused_dispatch(
+    args, parser, workload, mesh, n_chips, metrics, t0, ledger=None, warm_obs=None
+) -> int:
     """The fused algorithm dispatch + summary (run_fused's tail, split
     out so the graceful-shutdown catch wraps every fused path)."""
     import time
@@ -609,6 +725,8 @@ def _run_fused_dispatch(args, parser, workload, mesh, n_chips, metrics, t0) -> i
                 wave_size=args.wave_size,
                 checkpoint_dir=args.checkpoint_dir,
                 snapshot_every=args.checkpoint_every,
+                ledger=ledger,
+                warm_obs=warm_obs,
             ), args.retries, metrics)
             n_trials = args.population * args.generations
             extra = {"best_curve": [round(float(v), 4) for v in res["best_curve"]]}
@@ -642,6 +760,8 @@ def _run_fused_dispatch(args, parser, workload, mesh, n_chips, metrics, t0) -> i
                 member_chunk=args.member_chunk,
                 mesh=mesh,
                 checkpoint_dir=args.checkpoint_dir,
+                ledger=ledger,
+                warm_obs=warm_obs,
             ), args.retries, metrics)
             n_trials = res["n_trials"]
             extra = {"rung_sizes": res["rung_sizes"], "rung_budgets": res["rung_budgets"]}
@@ -657,6 +777,8 @@ def _run_fused_dispatch(args, parser, workload, mesh, n_chips, metrics, t0) -> i
                 member_chunk=args.member_chunk,
                 mesh=mesh,
                 checkpoint_dir=args.checkpoint_dir,
+                ledger=ledger,
+                warm_obs=warm_obs,
             ), args.retries, metrics)
             n_trials = res["n_trials"]
             extra = {"best_curve": [round(float(v), 4) for v in res["best_curve"]]}
@@ -671,6 +793,8 @@ def _run_fused_dispatch(args, parser, workload, mesh, n_chips, metrics, t0) -> i
                 member_chunk=args.member_chunk,
                 mesh=mesh,
                 checkpoint_dir=args.checkpoint_dir,
+                ledger=ledger,
+                warm_obs=warm_obs,
             ), args.retries, metrics)
             n_trials = res["n_trials"]
             extra = {"brackets": res["brackets"]}
@@ -685,6 +809,8 @@ def _run_fused_dispatch(args, parser, workload, mesh, n_chips, metrics, t0) -> i
                 member_chunk=args.member_chunk,
                 mesh=mesh,
                 checkpoint_dir=args.checkpoint_dir,
+                ledger=ledger,
+                warm_obs=warm_obs,
             ), args.retries, metrics)
             n_trials = res["n_trials"]
             extra = {"brackets": res["brackets"]}
@@ -731,6 +857,11 @@ def _run_fused_dispatch(args, parser, workload, mesh, n_chips, metrics, t0) -> i
     # the summary so staged_bytes/stage_overlap_s appear in it
     if res.get("staged_bytes") is not None:
         metrics.count_staging(res["staged_bytes"], res.get("stage_overlap_s", 0.0))
+    # fused ledger observability: member records appended this run vs
+    # re-verified on resume (parity with the driver path's replayed)
+    if res.get("journal") is not None:
+        metrics.count_journaled(res["journal"]["written"])
+        summary["journal"] = dict(res["journal"])
     metrics.summary(
         final=True,
         member_failures=(
@@ -802,12 +933,10 @@ def main(argv=None) -> int:
             "stateful path into a worker process; fused/TPU sweeps "
             "have no such path"
         )
-    if (args.ledger or args.warm_start) and args.fused:
-        parser.error(
-            "--ledger/--warm-start journal and replay per-trial driver "
-            "results; fused sweeps have no per-trial host loop (use "
-            "--checkpoint-dir for fused crash recovery)"
-        )
+    # --ledger/--warm-start work on BOTH paths: the driver journals per
+    # trial, fused sweeps journal per population member at every
+    # launch/rung/generation boundary (ledger/fused.py) — and warm-start
+    # is cross-mode (the records share space_hash/canonical params)
     if args.warm_start and args.ledger:
         import os
 

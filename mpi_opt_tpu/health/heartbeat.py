@@ -32,18 +32,30 @@ class Heartbeat:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         self.beats = 0
         self._warned = False
+        # beats arrive from more than one thread (the main loop AND
+        # StagingEngine's background transfer thread): the lock keeps
+        # the counter monotonic, and the thread-unique tmp name keeps a
+        # concurrent beat from truncating a sibling's half-written tmp
+        # out from under its rename
+        import threading
+
+        self._lock = threading.Lock()
 
     def beat(self, **progress) -> Optional[dict]:
         """Record one unit of progress; returns the record (None if the
-        write failed — warned once, never raised)."""
-        self.beats += 1
+        write failed — warned once, never raised). Thread-safe."""
+        import threading
+
+        with self._lock:
+            self.beats += 1
+            n = self.beats
         rec = {
             "pid": os.getpid(),
-            "beats": self.beats,
+            "beats": n,
             "ts": round(time.time(), 4),
             "progress": progress,
         }
-        tmp = f"{self.path}.tmp{os.getpid()}"
+        tmp = f"{self.path}.tmp{os.getpid()}.{threading.get_ident()}"
         try:
             with open(tmp, "w") as f:
                 f.write(json.dumps(rec))

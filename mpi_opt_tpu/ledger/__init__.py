@@ -14,21 +14,26 @@ evaluation.
 """
 
 from mpi_opt_tpu.ledger.cache import EvalCache
+from mpi_opt_tpu.ledger.fused import FusedJournal, make_journal
 from mpi_opt_tpu.ledger.store import (
     LEDGER_SCHEMA_VERSION,
     LedgerError,
     SweepLedger,
     read_ledger,
+    scan_boundaries,
     validate_ledger,
 )
 from mpi_opt_tpu.ledger.warmstart import warm_start
 
 __all__ = [
     "EvalCache",
+    "FusedJournal",
     "LEDGER_SCHEMA_VERSION",
     "LedgerError",
     "SweepLedger",
+    "make_journal",
     "read_ledger",
+    "scan_boundaries",
     "validate_ledger",
     "warm_start",
 ]

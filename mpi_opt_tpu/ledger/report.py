@@ -12,7 +12,12 @@ from __future__ import annotations
 import json
 from typing import Optional
 
-from mpi_opt_tpu.ledger.store import LedgerError, read_ledger, validate_ledger
+from mpi_opt_tpu.ledger.store import (
+    LedgerError,
+    read_ledger,
+    scan_boundaries,
+    validate_ledger,
+)
 
 # score trajectory rendered as a coarse unicode sparkline: enough to see
 # "when did the sweep stop improving" in a terminal without plotting
@@ -72,6 +77,24 @@ def summarize_ledger(path: str) -> dict:
     ts = [float(r["ts"]) for r in records if r.get("ts") is not None]
     span = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
     n = len(records)
+    fused = None
+    if cfg.get("mode") == "fused" or any("boundary" in r for r in records):
+        # fused member journal: the per-boundary view operators actually
+        # ask for — how many generations/rungs/batches are journaled and
+        # how many members each one lost to divergence
+        by_boundary, sizes, _problems, torn_final = scan_boundaries(records)
+        order = sorted(by_boundary)
+        fused = {
+            "granularity": cfg.get("granularity"),
+            "boundaries": len(order),
+            "member_records": sum(len(by_boundary[b]) for b in order),
+            "member_failures": [
+                sum(1 for r in by_boundary[b].values() if r["status"] != "ok")
+                for b in order
+            ],
+            "boundary_sizes": [sizes[b] for b in order],
+            "torn_boundary": torn_final,
+        }
     return {
         "path": path,
         "sweep_id": header.get("sweep_id"),
@@ -93,6 +116,7 @@ def summarize_ledger(path: str) -> dict:
         "trajectory": trajectory,
         "trials_per_sec": round(n / span, 4) if span > 0 else None,
         "eval_wall_s": round(wall_sum, 3),
+        "fused": fused,
     }
 
 
@@ -113,6 +137,23 @@ def _render_text(rep: dict) -> str:
     ]
     if rep["torn_tail_dropped"]:
         lines.append("  note: 1 torn tail line dropped (crash mid-append)")
+    if rep.get("fused"):
+        f = rep["fused"]
+        gran = f.get("granularity") or "boundary"
+        fails = f["member_failures"]
+        tail = ""
+        if len(fails) > 16:
+            fails, tail = fails[:16], f" ... ({len(f['member_failures'])} total)"
+        lines.append(
+            f"  fused: {f['boundaries']} {gran} boundaries, "
+            f"{f['member_records']} member records; failures/boundary: "
+            f"{fails}{tail}"
+        )
+        if f.get("torn_boundary") is not None:
+            lines.append(
+                f"  note: boundary {f['torn_boundary']} is torn (killed "
+                "mid-journal; --resume re-journals it)"
+            )
     if rep["best"] is None:
         lines.append("  best: none (no ok trial recorded)")
     else:
@@ -162,6 +203,37 @@ def replay_consistency(ledger_path: str, search_state: dict) -> list:
             f"snapshot records {len(missing)} final trial(s) absent from "
             f"the journal (trial ids {missing[:10]}"
             + ("...)" if len(missing) > 10 else ")")
+        ]
+    return []
+
+
+def fused_replay_consistency(ledger_path: str, boundaries_done: int) -> list:
+    """The boundary-granular twin of ``replay_consistency`` for FUSED
+    sweeps (fsck's cross-check): every boundary the newest verified
+    snapshot records as complete (``meta['boundaries_done']``) must be
+    FULLY journaled, because the fused drivers journal each boundary's
+    member records before saving its snapshot. A journaled prefix
+    shorter than the snapshot's boundary count means the pair is torn
+    (mixed directories, a hand-edited journal, or a ledger attached
+    mid-sweep) and a ``--ledger --resume`` would refuse.
+
+    Returns human-readable problems (empty = consistent).
+    """
+    try:
+        _header, records, _n_torn = read_ledger(ledger_path)
+    except (LedgerError, OSError) as e:
+        return [f"ledger unreadable for cross-check: {e}"]
+    by_boundary, sizes, problems, _torn_final = scan_boundaries(records)
+    if problems:
+        return [f"fused journal structure: {p}" for p in problems]
+    n = 0
+    while n in by_boundary and len(by_boundary[n]) == sizes[n]:
+        n += 1
+    if n < int(boundaries_done):
+        return [
+            f"snapshot records {boundaries_done} boundaries complete but "
+            f"only {n} are fully journaled — the journal lags the snapshot "
+            "it should never lag"
         ]
     return []
 

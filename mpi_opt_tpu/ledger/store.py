@@ -15,14 +15,30 @@ File format (one JSON object per line):
   FINAL means post-retry: the driver journals exactly one record per
   completed trial, after its FailurePolicy has resolved.
 
+FUSED sweeps journal through the SAME schema at member granularity
+(``ledger/fused.py``): their trial records additionally carry
+``member`` (population/cohort row identity), ``boundary`` (the global
+index of the natural boundary that produced the evaluation — PBT
+generation, SHA/BOHB rung, TPE batch) and ``boundary_size`` (how many
+member records that boundary journals), and their header ``config``
+marks ``mode: "fused"`` plus the boundary ``granularity``. One boundary
+is journaled as one contiguous block, so the only damage an append-kill
+can leave is a TORN FINAL BOUNDARY (fewer than ``boundary_size``
+records for the last boundary) — recoverable exactly like a torn tail
+line, because the journal-before-snapshot ordering guarantees no
+snapshot ever covers a partially-journaled boundary.
+
 Durability contract: each record is flushed AND fsync'd before the
-driver reports it to the algorithm, so the journal can never lag the
-search state it will be replayed into. Recovery is tolerant of exactly
-the failure append-fsync can produce — a TORN FINAL LINE (the process
-died mid-write): the tail fragment is truncated away on load and the
-journal continues from the last complete record. A malformed line
-anywhere ELSE means the file was edited or mixed with another stream,
-and loading refuses rather than guessing.
+driver reports it to the algorithm (fused: before the boundary's
+snapshot is saved), so the journal can never lag the search state it
+will be replayed into. Recovery is tolerant of exactly the failures
+append-fsync can produce — a TORN FINAL LINE (the process died
+mid-write): the tail fragment is truncated away on load and the
+journal continues from the last complete record; and, for fused
+journals, a TORN FINAL BOUNDARY (the process died between a boundary's
+member records), truncated the same way. A malformed line — or a
+partially-journaled boundary — anywhere ELSE means the file was edited
+or mixed with another stream, and loading refuses rather than guessing.
 """
 
 from __future__ import annotations
@@ -58,6 +74,100 @@ def _check_trial_record(rec: dict, lineno: int) -> None:
         raise LedgerError(f"line {lineno}: unknown status {rec['status']!r}")
     if rec["status"] == "ok" and not isinstance(rec.get("score"), (int, float)):
         raise LedgerError(f"line {lineno}: ok record without a numeric score")
+    if "boundary" in rec:
+        fused_missing = [k for k in ("member", "boundary_size") if k not in rec]
+        if fused_missing:
+            raise LedgerError(
+                f"line {lineno}: fused member record missing {fused_missing}"
+            )
+
+
+def scan_boundaries(records: Sequence[dict]):
+    """Group fused member records by boundary and judge the grouping.
+
+    Returns ``(by_boundary, sizes, problems, torn_final)``:
+    ``by_boundary`` maps boundary index -> {member: record}; ``sizes``
+    maps boundary -> its declared ``boundary_size``; ``problems`` lists
+    structural damage that append-crash CANNOT produce (a hand-edited
+    or mixed file); ``torn_final`` is the final boundary's index when
+    it is partially journaled — the ONE shape a mid-journal kill leaves
+    (recoverable: the journal-before-snapshot ordering means no
+    snapshot covers it) — else None.
+
+    Rules enforced: fused and driver records never mix in one journal;
+    boundary indices are non-decreasing and contiguous blocks (a
+    boundary never resumes after another started); within a boundary,
+    ``boundary_size`` is consistent, members are unique, and the count
+    never exceeds the declared size; boundary 0 exists and indices have
+    no gaps; only the FINAL boundary may be short.
+    """
+    by_boundary: dict[int, dict[int, dict]] = {}
+    sizes: dict[int, int] = {}
+    problems: list[str] = []
+    last_b = None
+    saw_driver = False
+    for rec in records:
+        if "boundary" not in rec:
+            saw_driver = True
+            if by_boundary:
+                problems.append(
+                    f"trial {rec['trial_id']}: driver record mixed into a "
+                    "fused member journal"
+                )
+            continue
+        if saw_driver and not by_boundary:
+            # the mirror order (driver records first) is the same mixed
+            # file and must be refused the same way
+            problems.append(
+                f"trial {rec['trial_id']}: fused member record mixed "
+                "into a driver journal"
+            )
+        b = int(rec["boundary"])
+        m = int(rec["member"])
+        size = int(rec["boundary_size"])
+        if last_b is not None and b < last_b:
+            problems.append(
+                f"boundary {b}: records out of order (after boundary {last_b})"
+            )
+        if b in by_boundary and last_b != b:
+            problems.append(
+                f"boundary {b}: non-contiguous (resumes after boundary {last_b})"
+            )
+        grp = by_boundary.setdefault(b, {})
+        if b in sizes and sizes[b] != size:
+            problems.append(
+                f"boundary {b}: inconsistent boundary_size "
+                f"({sizes[b]} vs {size})"
+            )
+        sizes.setdefault(b, size)
+        if m in grp:
+            problems.append(f"boundary {b}: member {m} journaled twice")
+        grp[m] = rec
+        if len(grp) > sizes[b]:
+            problems.append(
+                f"boundary {b}: {len(grp)} member records exceed the "
+                f"declared boundary_size {sizes[b]}"
+            )
+        last_b = b
+    torn_final = None
+    if by_boundary:
+        order = sorted(by_boundary)
+        if order != list(range(order[-1] + 1)):
+            problems.append(
+                "boundary indices are not the contiguous range "
+                f"0..{order[-1]}: missing "
+                f"{sorted(set(range(order[-1] + 1)) - set(order))}"
+            )
+        for b in order:
+            if len(by_boundary[b]) < sizes[b]:
+                if b == last_b:
+                    torn_final = b
+                else:
+                    problems.append(
+                        f"boundary {b}: only {len(by_boundary[b])}/{sizes[b]} "
+                        "member records journaled mid-file"
+                    )
+    return by_boundary, sizes, problems, torn_final
 
 
 def read_ledger(path: str, strict: bool = False):
@@ -128,6 +238,18 @@ def validate_ledger(path: str) -> list[str]:
         if tid in seen:
             problems.append(f"trial {tid}: duplicated final record")
         seen.add(tid)
+    if any("boundary" in r for r in records):
+        # fused member journal: the boundary-granular invariants are
+        # part of the schema — a torn FINAL boundary is flagged here
+        # (strict mode reports damage; the resume path self-heals it)
+        _by, sizes, b_problems, torn_final = scan_boundaries(records)
+        problems += b_problems
+        if torn_final is not None:
+            problems.append(
+                f"boundary {torn_final}: torn ({len(_by[torn_final])}/"
+                f"{sizes[torn_final]} member records — killed mid-journal; "
+                "a --resume truncates and re-journals it)"
+            )
     return problems
 
 
@@ -180,19 +302,70 @@ class SweepLedger:
         self.header: Optional[dict] = None
         self.records: list[dict] = []
         self.n_torn = 0
+        self.n_torn_boundary = 0  # member records of a torn final boundary
         if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
             self.header, self.records, self.n_torn = read_ledger(self.path)
-            if self.n_torn and not self.read_only:
-                self._truncate_torn_tail()
+            self._drop_torn_boundary()
+            if (self.n_torn or self.n_torn_boundary) and not self.read_only:
+                self._rewrite_complete_records()
         if self.read_only:
             self._file = None
             return
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         self._file = open(self.path, "a")
 
-    def _truncate_torn_tail(self) -> None:
-        # keep exactly the bytes of the complete lines; the torn
-        # fragment must not prefix the next append
+    def _drop_torn_boundary(self) -> None:
+        """Fused journals only: a partially-journaled FINAL boundary is
+        the mid-journal-kill shape — drop its records so replay sees
+        only complete boundaries (the interrupted boundary re-trains
+        from its snapshot and re-journals identically; the ordering
+        contract guarantees no snapshot covers the partial one). Any
+        OTHER boundary damage cannot come from an append crash and
+        refuses to load. Records are dropped from the in-memory view on
+        every rank; only a writable (rank-0) ledger rewrites the file.
+        """
+        if not any("boundary" in r for r in self.records):
+            return
+        by_boundary, _sizes, problems, torn_final = scan_boundaries(self.records)
+        if problems:
+            raise LedgerError(
+                f"{self.path}: fused boundary structure is damaged beyond "
+                f"what an append crash can produce ({problems[0]}) — "
+                "refusing to load"
+            )
+        if torn_final is None:
+            return
+        keep = [
+            r for r in self.records
+            if int(r.get("boundary", -1)) != torn_final
+        ]
+        self.n_torn_boundary += len(self.records) - len(keep)
+        self.records = keep
+
+    def drop_torn_boundary(self) -> int:
+        """Self-heal a torn final boundary on an OPEN ledger: the
+        in-process twin of the load-time truncation, for callers that
+        re-enter a fused sweep with the same ledger object after an
+        error escaped mid-boundary (the CLI's --retries does exactly
+        this when a transient runtime failure strikes during a
+        boundary's journaling) — without it, the re-run would
+        misdiagnose the partial boundary as a sweep-shape divergence.
+        Drops the records from memory AND rewrites the file (reopening
+        the append handle — the rewrite replaces the inode). Returns
+        how many records were dropped."""
+        before = len(self.records)
+        self._drop_torn_boundary()
+        dropped = before - len(self.records)
+        if dropped and not self.read_only and self._file is not None:
+            self._file.close()
+            self._rewrite_complete_records()
+            self._file = open(self.path, "a")
+        return dropped
+
+    def _rewrite_complete_records(self) -> None:
+        # keep exactly the bytes of the complete records (torn tail
+        # fragment and torn-final-boundary lines dropped); the debris
+        # must not prefix the next append
         good = [json.dumps(self.header)] if self.header else []
         good += [json.dumps(r) for r in self.records]
         # rewrite-then-replace, not open('w'): a second crash here must
@@ -278,6 +451,54 @@ class SweepLedger:
             self._write_line(rec)
         # read-only ranks still track the record in memory: completed()
         # and the dedup views must agree with rank 0's across the gang
+        self.records.append(rec)
+        return rec
+
+    def record_member(
+        self,
+        *,
+        trial_id: int,
+        member: int,
+        boundary: int,
+        boundary_size: int,
+        canonical_params: dict,
+        score,
+        step: int,
+    ) -> dict:
+        """Journal one fused population member's boundary evaluation
+        (``ledger/fused.py`` drives this); durable before returning.
+
+        Status derives from the score's finiteness — the same rule the
+        fused trainers' member-failure tallies apply: a non-finite
+        member score is the fused divergence failure, journaled as
+        ``failed`` with a null score so JSON stays strict.
+        """
+        if self.header is None:
+            raise LedgerError("ledger has no header — call ensure_header first")
+        score = float(score)
+        finite = np.isfinite(score)
+        rec = {
+            "kind": "trial",
+            "sweep_id": self.sweep_id,
+            "trial_id": int(trial_id),
+            "member": int(member),
+            "boundary": int(boundary),
+            "boundary_size": int(boundary_size),
+            "params": canonical_params,
+            "status": "ok" if finite else "failed",
+            "score": score if finite else None,
+            "step": int(step),
+            "error": None if finite else "non-finite member score",
+            "attempts": 1,
+            # member evaluations share one fused boundary program; no
+            # per-member wall exists (the boundary's wall lives in the
+            # sweep result's launch_walls/gen_walls)
+            "wall_s": 0.0,
+            "cached": False,
+            "ts": round(time.time(), 4),
+        }
+        if not self.read_only:
+            self._write_line(rec)
         self.records.append(rec)
         return rec
 

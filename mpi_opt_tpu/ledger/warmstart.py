@@ -8,6 +8,14 @@ round trip — and hands them to ``Algorithm.ingest_observations``: TPE
 and BOHB build surrogate priors, random/ASHA seed their first
 suggestions with the prior best (see each algorithm's override).
 
+Warm start is CROSS-MODE: fused member records (ledger/fused.py) carry
+the same canonical params / score / step fields as driver trial
+records, so a fused sweep's ledger seeds a driver TPE/BOHB search and
+a driver ledger seeds a fused one (the fused drivers pre-fill their
+observation buffers / seed their cohorts — see each driver's
+``warm_obs``). The ONLY compatibility gate is the space hash; the mode
+that produced the observations is irrelevant to their evidence value.
+
 Space compatibility is checked by HASH, not by hope: a ledger written
 for a different space would decode its params into the wrong unit
 coordinates and silently poison the new search, so a mismatch raises.
@@ -74,6 +82,17 @@ def load_observations(path: str, space) -> list[Observation]:
             )
         )
     return obs
+
+
+def best_observation(observations) -> "Observation | None":
+    """The highest FINITE-scored prior observation, or None — the point
+    the sampler-family consumers (driver random/ASHA, fused cohort
+    seeding) start from. Non-finite priors never seed: a diverged prior
+    point is exactly what a new sweep must not start at."""
+    import numpy as np
+
+    finite = [o for o in observations if np.isfinite(o.score)]
+    return max(finite, key=lambda o: o.score) if finite else None
 
 
 def warm_start(algorithm: Algorithm, path: str) -> int:

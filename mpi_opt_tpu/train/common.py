@@ -101,6 +101,37 @@ def launch_boundary(stage: str, *, final: bool, snapshot=None, **progress) -> No
     raise shutdown.SweepInterrupted(shutdown.active_signal(), at=stage)
 
 
+def journal_boundary(journal, b_local: int, members, units, scores, step: int) -> None:
+    """The fused drivers' shared ledger service point, paired with
+    ``launch_boundary``: called once per natural boundary (PBT
+    generation, SHA/BOHB rung, TPE batch) with the boundary's member
+    identities, unit rows, and scores — BEFORE that boundary's snapshot
+    is saved, so the journal never lags the snapshot (the fused twin of
+    the driver path's fsync-before-report invariant). No-op without a
+    journal; on a re-computed boundary (resume) it verifies against the
+    journal instead of re-writing (ledger/fused.py)."""
+    if journal is None:
+        return
+    journal.record_boundary(b_local, members, units, scores, step)
+
+
+def journal_require_prefix(journal, n_boundaries: int) -> None:
+    """Resume-time consistency gate: every boundary the restored
+    snapshot records as complete must already be fully journaled
+    (``FusedJournal.require_prefix``); no-op without a journal."""
+    if journal is not None:
+        journal.require_prefix(n_boundaries)
+
+
+def make_fused_journal(ledger, space, **offsets):
+    """``ledger/fused.make_journal`` re-export at the drivers' layer:
+    the four fused drivers build their journal views through one door
+    so offsets/construction cannot drift between them."""
+    from mpi_opt_tpu.ledger.fused import make_journal
+
+    return make_journal(ledger, space, **offsets)
+
+
 class HParamsFn:
     """Hashable (space, workload)-bound unit->OptHParams mapping, usable
     as a static jit argument (identity-hashed: space/workload come from

@@ -35,7 +35,10 @@ import numpy as np
 from mpi_opt_tpu.ops.asha import asha_cut, asha_rungs
 from mpi_opt_tpu.train.common import (
     finite_winner,
+    journal_boundary,
+    journal_require_prefix,
     launch_boundary,
+    make_fused_journal,
     momentum_dtype_str,
     workload_arrays,
 )
@@ -80,8 +83,23 @@ def fused_sha(
     round_to: int = 1,
     checkpoint_dir: str = None,
     init_unit=None,
+    ledger=None,
+    boundary_offset: int = 0,
+    trial_offset: int = 0,
+    member_offset: int = 0,
+    warm_obs=None,
 ):
     """Run a whole successive-halving sweep with on-device rung cuts.
+
+    ``ledger`` journals one record per surviving trial per rung —
+    pre-cut score at the rung's budget, the trial's unit params —
+    BEFORE the rung's snapshot (ledger/fused.py); the three offsets
+    place this sweep's boundaries/records/trial identities inside a
+    composite journal (fused hyperband/BOHB give each bracket its
+    global offsets). ``warm_obs`` (prior-ledger observations,
+    cross-mode) seeds cohort row 0 with the prior best point — ignored
+    when the caller supplies ``init_unit`` (model-based callers own
+    their cohorts).
 
     Returns a dict with the best trial's score/params, per-rung sizes
     and budgets, and a per-trial ledger (stop rung + last score).
@@ -173,11 +191,31 @@ def fused_sha(
             # stop-rung observations are still in last_score, so the
             # history is marked partial rather than fabricated
             rung_history = list(meta.get("rung_history", []))
+    journal = make_fused_journal(
+        ledger,
+        space,
+        boundary_offset=boundary_offset,
+        trial_offset=trial_offset,
+        member_offset=member_offset,
+    )
+    journal_require_prefix(journal, start_rung)
     if restored is None:
         if init_unit is not None:
             unit = jax.numpy.asarray(init_unit)
         else:
             unit = space.sample_unit(k_unit, n_trials)
+            if warm_obs:
+                from mpi_opt_tpu.ledger.warmstart import best_observation
+
+                bo = best_observation(warm_obs)
+                if bo is not None:
+                    # sampler-family warm start (mirrors driver ASHA's
+                    # seeded first suggestion): one cohort row starts at
+                    # the prior best; the rung cuts keep it only if it
+                    # earns survival
+                    unit = np.array(unit)
+                    unit[0] = np.asarray(bo.unit, dtype=unit.dtype)
+                    unit = jax.numpy.asarray(unit)
         state = trainer.init_population(k_init, train_x[:2], n_trials)
     if mesh is not None:
         # datasets were already replicated over the mesh by workload_arrays
@@ -205,8 +243,10 @@ def fused_sha(
     # launch + round-trip per rung (the tunnel charges 20-90 ms per
     # blocking fetch; a 4-rung config-2 sweep paid ~7 of them).
     # Checkpointed sweeps keep the per-rung fetch: each snapshot needs
-    # host copies of the ledger at that rung.
-    defer = snap is None
+    # host copies of the ledger at that rung. A fused JOURNAL forces the
+    # eager path too: its records must be fsync-durable per rung (the
+    # journal-before-snapshot ordering), which deferral would break.
+    defer = snap is None and journal is None
     rung_scores_dev: list = []  # device scores per rung (pre-cut rows)
     rung_keep_dev: list = []  # device survivor indices per cut
     try:
@@ -224,6 +264,13 @@ def fused_sha(
             else:
                 np_scores = fetch_global(scores)
                 record_rung(r, np_scores)
+                if journal is not None:
+                    # one member record per PRE-cut survivor at this
+                    # rung's budget, before the rung snapshot below
+                    journal_boundary(
+                        journal, r, alive, fetch_global(unit), np_scores,
+                        step=budget,
+                    )
             if r < len(rungs) - 1:
                 state, unit, keep, _ = _cut_and_gather(
                     trainer, state, unit, scores, eta, sizes[r + 1]
@@ -248,6 +295,9 @@ def fused_sha(
                     r + 1, state, unit, k_run, np_scores,
                     meta_extra={
                         "rungs_done": r + 1,
+                        # ledger cross-check unit (fsck, resume gate):
+                        # GLOBAL boundary count complete at this snapshot
+                        "boundaries_done": boundary_offset + r + 1,
                         "alive": alive.tolist(),
                         "stop_rung": stop_rung.tolist(),
                         "last_score": [float(v) for v in last_score],
@@ -316,6 +366,9 @@ def fused_sha(
             for rh in rung_history
         ],
         "n_trials": n_trials,
+        "journal": None
+        if journal is None
+        else {"written": journal.written, "verified": journal.verified},
     }
 
 
@@ -384,6 +437,8 @@ def fused_hyperband(
     checkpoint_dir: str = None,
     cohort_fn=None,
     observe_fn=None,
+    ledger=None,
+    warm_obs=None,
 ):
     """Hyperband with every bracket running as a fused on-device SHA.
 
@@ -415,6 +470,7 @@ def fused_hyperband(
     best = None
     brackets = []
     n_total = 0
+    journal_totals = {"written": 0, "verified": 0}
     # the persisted-cohort identity: workload + bracket plan + seed
     # (everything that determines which search the cohorts belong to)
     tag = (
@@ -422,6 +478,11 @@ def fused_hyperband(
         f"|R={max_budget}|eta={eta}|seed={seed}"
     )
     plan = bracket_plan(max_budget, eta)
+    # one ledger spans the brackets: each fused_sha journals under its
+    # bracket's GLOBAL offsets so the whole sweep reads as one
+    # contiguous boundary sequence (ledger/fused.py). The offset math
+    # mirrors fused_sha's own rung/size derivation exactly.
+    boundary_off = trial_off = member_off = 0
     for b, (n, r) in enumerate(plan):
         if cohort_fn is None:
             cohort, n_model = None, None
@@ -441,10 +502,24 @@ def fused_hyperband(
                 os.path.join(checkpoint_dir, f"bracket_{b}") if checkpoint_dir else None
             ),
             init_unit=cohort,
+            ledger=ledger,
+            boundary_offset=boundary_off,
+            trial_offset=trial_off,
+            member_offset=member_off,
+            # model-based callers (BOHB) own their cohorts AND their
+            # prior ingestion (ObsStore); only the hookless hyperband
+            # seeds bracket cohorts with the prior best
+            warm_obs=warm_obs if cohort_fn is None else None,
         )
+        boundary_off += len(res["rung_budgets"])
+        trial_off += sum(res["rung_sizes"])
+        member_off += n
         if observe_fn is not None:
             observe_fn(b, cohort, res)
         n_total += n
+        if res.get("journal"):
+            journal_totals["written"] += res["journal"]["written"]
+            journal_totals["verified"] += res["journal"]["verified"]
         summary = {
             "bracket": b,
             "n_trials": n,
@@ -487,4 +562,5 @@ def fused_hyperband(
             n for s in brackets for n in s["member_failures"]
         ],
         "n_trials": n_total,
+        "journal": journal_totals if ledger is not None else None,
     }

@@ -49,13 +49,26 @@ def fused_bohb(
     n_min: int | None = None,
     buffer_size: int = 512,
     cfg: TPEConfig = TPEConfig(),
+    ledger=None,
+    warm_obs=None,
 ):
     """Returns the overall best plus per-bracket summaries (including
-    how many of each cohort came from the model vs uniform)."""
+    how many of each cohort came from the model vs uniform).
+
+    ``ledger`` journals every bracket's rung evaluations at member
+    granularity through ``fused_hyperband``'s per-bracket offsets.
+    ``warm_obs`` (prior-ledger observations, cross-mode) files into the
+    same per-budget ``ObsStore`` the rung results feed — the model can
+    qualify (``n_min``) before the first bracket even runs, exactly the
+    driver BOHB warm-start semantic."""
     _, space, *_ = workload_arrays(workload, member_chunk, mesh)
     if n_min is None:
         n_min = default_n_min(space.dim)
     obs = ObsStore(space.dim, buffer_size, n_min)
+    if warm_obs:
+        for o in warm_obs:
+            if np.isfinite(float(o.score)):
+                obs.add(int(o.budget), np.asarray(o.unit), float(o.score))
     suggest = jax.jit(tpe_suggest, static_argnames=("n_suggest", "cfg"))
 
     def cohort_fn(b: int, n: int):
@@ -104,4 +117,9 @@ def fused_bohb(
         checkpoint_dir=checkpoint_dir,
         cohort_fn=cohort_fn,
         observe_fn=observe_fn,
+        ledger=ledger,
+        # priors already live in the ObsStore above; passing them down
+        # would ALSO seed bracket cohorts (the hookless-hyperband
+        # semantic) and double-count the prior
+        warm_obs=None,
     )

@@ -32,7 +32,14 @@ import jax
 import jax.numpy as jnp
 
 from mpi_opt_tpu.ops.pbt import PBTConfig, pbt_exploit_explore
-from mpi_opt_tpu.train.common import finite_winner, launch_boundary, momentum_dtype_str
+from mpi_opt_tpu.train.common import (
+    finite_winner,
+    journal_boundary,
+    journal_require_prefix,
+    launch_boundary,
+    make_fused_journal,
+    momentum_dtype_str,
+)
 from mpi_opt_tpu.train.population import OptHParams, PopState, PopulationTrainer
 
 
@@ -57,12 +64,18 @@ def run_fused_pbt(
     cfg: PBTConfig = PBTConfig(),
 ):
     """Returns (state, unit, key', best_curve[G], mean_curve[G],
-    member_fail[G], final_scores[P]).
+    member_fail[G], final_scores[P], pre_scores[G, P], pre_units[G, P, d]).
 
     ``member_fail`` counts the PRE-exploit members whose eval came back
     non-finite each generation — the divergence the exploit step then
     masks by replacing losers with winners. Tallied in-scan (one int32
     per generation) so reporting it costs no extra fetch.
+
+    ``pre_scores``/``pre_units`` are each generation's PRE-exploit
+    member scores and the unit rows those members actually trained
+    with — the per-member facts the fused ledger journals (one record
+    per member per generation; ledger/fused.py). They ride the scan's
+    stacked outputs, so collecting them costs no extra program.
 
     ``key'`` is the scan-carried RNG key after ``generations`` steps of
     the chain — feeding it into a following call continues the EXACT
@@ -85,12 +98,14 @@ def run_fused_pbt(
         # pre-exploit scores (weights are copied verbatim, eval is
         # deterministic) — so no final re-eval is ever needed
         n_fail = jnp.sum(~jnp.isfinite(scores)).astype(jnp.int32)
-        return (st, new_u, k), (scores.max(), scores.mean(), n_fail, scores[src_idx])
+        return (st, new_u, k), (
+            scores.max(), scores.mean(), n_fail, scores[src_idx], scores, u,
+        )
 
-    (state, unit, key), (best, mean, fails, gen_scores) = jax.lax.scan(
-        one_generation, (state, unit, key), jnp.arange(generations)
+    (state, unit, key), (best, mean, fails, gen_scores, pre_scores, pre_units) = (
+        jax.lax.scan(one_generation, (state, unit, key), jnp.arange(generations))
     )
-    return state, unit, key, best, mean, fails, gen_scores[-1]
+    return state, unit, key, best, mean, fails, gen_scores[-1], pre_scores, pre_units
 
 
 def _balanced_split(total: int, chunk: int) -> list[int]:
@@ -125,13 +140,21 @@ def finish_generation(
     population, run exploit/explore, gather winner states — the tail of
     ``run_fused_pbt.one_generation`` without the training scan (which
     ran as separate ``train_segment`` launches). Returns
-    (state, unit, best, mean, n_fail, post_exploit_scores)."""
+    (state, unit, best, mean, n_fail, post_exploit_scores, pre_scores,
+    pre_unit) — the pre-exploit scores AND the unit matrix the
+    generation trained with ride along for the fused ledger's
+    per-member records, mirroring ``run_fused_pbt``'s stacked outputs
+    (``unit`` is donated, so the caller must take the pre-exploit view
+    from the OUTPUT, not its dead input reference)."""
     disc = jnp.asarray(discrete_mask, dtype=bool)
     scores = trainer.eval_population(state, val_x, val_y)
     new_u, src_idx, _ = pbt_exploit_explore(key, unit, scores, disc, cfg)
     state = trainer.gather_members(state, src_idx)
     n_fail = jnp.sum(~jnp.isfinite(scores)).astype(jnp.int32)
-    return state, new_u, scores.max(), scores.mean(), n_fail, scores[src_idx]
+    return (
+        state, new_u, scores.max(), scores.mean(), n_fail, scores[src_idx],
+        scores, unit,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("discrete_mask", "cfg"))
@@ -264,6 +287,8 @@ def _fused_pbt_waves(
     checkpoint_dir,
     snapshot_every: int,
     snapshot_last: bool,
+    ledger=None,
+    warm_obs=None,
 ):
     """Wave-scheduled fused PBT: ``population > residency``.
 
@@ -379,8 +404,19 @@ def _fused_pbt_waves(
             else:
                 k_run = restored_key
                 post_scores = np.asarray(sweep["scores"])
+    journal = make_fused_journal(ledger, space)
+    journal_require_prefix(journal, start_gen)
     if restored is None:
         unit = space.sample_unit(k_unit, population)
+        if warm_obs:
+            from mpi_opt_tpu.ledger.warmstart import best_observation
+
+            bo = best_observation(warm_obs)
+            if bo is not None:
+                # same sampler-family seeding as the resident path
+                unit = np.array(unit)
+                unit[0] = np.asarray(bo.unit, dtype=unit.dtype)
+                unit = jnp.asarray(unit)
         perm = np.arange(population)
         # the cold population's host residence; gen 0 fills it by
         # stage-out (members init on device per wave)
@@ -491,6 +527,9 @@ def _fused_pbt_waves(
                         meta_extra={
                             "gen": g,
                             "waves_done": w + 1,
+                            # a mid-generation snapshot completes no
+                            # boundary: only g generations are journaled
+                            "boundaries_done": g,
                             "best": best_list,
                             "mean": mean_list,
                             "member_fail": fail_list,
@@ -513,6 +552,17 @@ def _fused_pbt_waves(
             # generation boundary: the ONLY hard transfer barrier —
             # exploit needs the full score vector and a settled pool
             engine.drain()
+            # journal this generation's members (pre-exploit scores +
+            # the units they trained with) BEFORE the boundary snapshot;
+            # a resumed generation verifies instead of re-writing
+            journal_boundary(
+                journal,
+                g,
+                np.arange(population),
+                fetch_global(unit),
+                scores_host,
+                step=(g + 1) * steps_per_gen,
+            )
             scores_dev = jnp.concatenate([jnp.asarray(s) for s in wave_scores])
             new_unit, src_idx, best, mean, n_fail, post = _wave_exploit(
                 k_pbt, unit, scores_dev, discrete_mask=disc, cfg=cfg
@@ -546,6 +596,7 @@ def _fused_pbt_waves(
                     meta_extra={
                         "gen": g + 1,
                         "waves_done": 0,
+                        "boundaries_done": g + 1,
                         "best": best_list,
                         "mean": mean_list,
                         "member_fail": fail_list,
@@ -599,6 +650,9 @@ def _fused_pbt_waves(
         "stage_transfer_s": float(engine.transfer_s),
         "stage_wait_s": float(engine.wait_s),
         "stage_overlap_s": float(engine.overlap_s),
+        "journal": None
+        if journal is None
+        else {"written": journal.written, "verified": journal.verified},
     }
 
 
@@ -640,10 +694,15 @@ def _run_stepped_generation(
         # beats, so launch.py's --stall-timeout can be sized to one
         # step_chunk instead of a whole generation's train_segment scan
         heartbeat.beat(stage=f"pbt train sub-launch {i + 1}/{len(sub_lens)}")
-    state, unit, best, mean, n_fail, gen_scores = finish_generation(
-        trainer, state, unit, k_pbt, val_x, val_y, discrete_mask=disc, cfg=cfg
+    state, unit, best, mean, n_fail, gen_scores, pre_scores, pre_unit = (
+        finish_generation(
+            trainer, state, unit, k_pbt, val_x, val_y, discrete_mask=disc, cfg=cfg
+        )
     )
-    return state, unit, key, best[None], mean[None], n_fail[None], gen_scores
+    return (
+        state, unit, key, best[None], mean[None], n_fail[None], gen_scores,
+        pre_scores[None], pre_unit[None],
+    )
 
 
 def fused_pbt(
@@ -661,9 +720,20 @@ def fused_pbt(
     checkpoint_dir: str = None,
     snapshot_every: int = 1,
     snapshot_last: bool = True,
+    ledger=None,
+    warm_obs=None,
 ):
     """Convenience wrapper: run a whole PBT sweep for a vision-style
     workload; optionally sharded over a ``('pop','data')`` mesh.
+
+    ``ledger`` (an open ``SweepLedger`` whose fused header the CLI has
+    already committed) journals one record per member per generation —
+    pre-exploit score + the unit the member trained with — BEFORE that
+    generation's snapshot saves; on resume, already-journaled
+    generations are verified instead of re-written (ledger/fused.py).
+    ``warm_obs`` (prior-ledger ``Observation``s, cross-mode) seeds the
+    initial population's row 0 with the prior best point — the
+    sampler-family warm-start semantic, matching driver random/ASHA.
 
     Returns a result dict with the best member's hparams and curves.
     (For FLOPs/MFU accounting of a sweep, call
@@ -775,6 +845,8 @@ def fused_pbt(
                 checkpoint_dir,
                 snapshot_every,
                 snapshot_last,
+                ledger,
+                warm_obs,
             )
     key = jax.random.key(seed)
     k_init, k_unit, k_run = jax.random.split(key, 3)
@@ -857,8 +929,25 @@ def fused_pbt(
                 fail_parts = [np.asarray(v, dtype=np.int32) for v in meta["member_fail"]]
             else:
                 fails_complete = False
+    journal = make_fused_journal(ledger, space)
+    # resume gate: every generation the snapshot records complete must
+    # already be journaled (journal-before-snapshot ordering); the
+    # re-trained generations past the snapshot verify against their
+    # records instead of re-writing
+    journal_require_prefix(journal, sum(launch_lens[:start_launch]))
     if restored is None:
         unit = space.sample_unit(k_unit, population)
+        if warm_obs:
+            from mpi_opt_tpu.ledger.warmstart import best_observation
+
+            bo = best_observation(warm_obs)
+            if bo is not None:
+                # sampler-family warm start: one population row starts
+                # at the prior sweep's best point; PBT's exploit/explore
+                # spreads it if it earns its keep
+                unit = np.array(unit)
+                unit[0] = np.asarray(bo.unit, dtype=unit.dtype)
+                unit = jax.numpy.asarray(unit)
         state = trainer.init_population(k_init, train_x[:2], population)
     if mesh is not None:
         from mpi_opt_tpu.parallel.mesh import place_pop
@@ -882,7 +971,7 @@ def fused_pbt(
             if step_chunk > 0:
                 # one generation as k sub-segment launches + a boundary
                 # launch; the carried key advances exactly once per gen
-                state, unit, k_run, best, mean, fails, final_scores = _run_stepped_generation(
+                state, unit, k_run, best, mean, fails, final_scores, pre_s, pre_u = _run_stepped_generation(
                     trainer,
                     state,
                     unit,
@@ -901,7 +990,7 @@ def fused_pbt(
                 # k_run is the scan-carried key returned by the previous
                 # launch: the chain continues exactly as one longer scan
                 # would
-                state, unit, k_run, best, mean, fails, final_scores = run_fused_pbt(
+                state, unit, k_run, best, mean, fails, final_scores, pre_s, pre_u = run_fused_pbt(
                     trainer,
                     state,
                     unit,
@@ -928,12 +1017,32 @@ def fused_pbt(
             # PERF_NOTES.md), so the duration is measured AFTER them and
             # BEFORE any snapshot save
             launch_walls.append(time.perf_counter() - t_launch)
+            if journal is not None:
+                # journal this launch's generations BEFORE its snapshot
+                # (the boundary ordering contract); re-trained
+                # generations of a resume verify instead of re-writing
+                np_pre_s = fetch_global(pre_s)
+                np_pre_u = fetch_global(pre_u)
+                gens_before = sum(launch_lens[:i])
+                for j in range(launch_lens[i]):
+                    g = gens_before + j
+                    journal_boundary(
+                        journal,
+                        g,
+                        np.arange(population),
+                        np_pre_u[j],
+                        np_pre_s[j],
+                        step=(g + 1) * steps_per_gen,
+                    )
             is_last = i + 1 == n_launches
             due = (i + 1) % snapshot_every == 0
 
             def save_now(i=i):
                 meta_extra = {
                     "launches_done": i + 1,
+                    # the ledger cross-check unit (fsck, resume gate):
+                    # generations complete at this snapshot
+                    "boundaries_done": sum(launch_lens[: i + 1]),
                     "best": [v.tolist() for v in best_parts],
                     "mean": [v.tolist() for v in mean_parts],
                 }
@@ -1000,4 +1109,9 @@ def fused_pbt(
         # unknown — callers fall back to wall_to_target
         "launch_gens": launch_lens,
         "launch_walls": [float(w) for w in launch_walls] if walls_complete else None,
+        # ledger observability: how many member records this run
+        # appended vs re-verified on resume (None = no ledger active)
+        "journal": None
+        if journal is None
+        else {"written": journal.written, "verified": journal.verified},
     }

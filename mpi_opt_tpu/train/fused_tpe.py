@@ -28,7 +28,10 @@ import numpy as np
 from mpi_opt_tpu.ops.tpe import TPEConfig, tpe_suggest
 from mpi_opt_tpu.train.common import (
     finite_winner,
+    journal_boundary,
+    journal_require_prefix,
     launch_boundary,
+    make_fused_journal,
     momentum_dtype_str,
     workload_arrays,
 )
@@ -89,9 +92,22 @@ def fused_tpe(
     member_chunk: int = 0,
     mesh=None,
     checkpoint_dir: str = None,
+    ledger=None,
+    warm_obs=None,
 ):
     """Run an n_trials TPE sweep as ceil(n_trials/batch) fused
     generations (the last one sized to the remainder).
+
+    ``ledger`` journals one record per suggestion per generation batch
+    (unit params + score at the trial budget) before the generation's
+    snapshot saves; resume verifies already-journaled batches
+    (ledger/fused.py). ``warm_obs`` (prior-ledger observations,
+    cross-mode) PRE-FILLS the on-device observation ring: the buffer
+    grows by the finite-scored prior count and the acquisition kernel
+    sees the priors from its first suggestion — the fused equivalent of
+    driver TPE's surrogate warm start. Warm rows are facts, not trials:
+    they are barred from the best pick and the curve, and ``n_warm`` is
+    part of the checkpoint identity (the buffer shape depends on it).
 
     Returns best score/params, the per-generation cumulative-best curve,
     and the full observation history. ``checkpoint_dir`` makes the sweep
@@ -115,7 +131,11 @@ def fused_tpe(
     sizes = [batch] * (n_trials // batch)
     if n_trials % batch:
         sizes.append(n_trials % batch)
-    M = n_trials  # buffer exactly fits the sweep
+    # finite-scored priors only: a diverged prior point carries no
+    # evidence the model should build on (same rule as driver ingest)
+    warm = [o for o in (warm_obs or []) if np.isfinite(float(o.score))]
+    n_warm = len(warm)
+    M = n_trials + n_warm  # buffer fits the sweep plus the priors
 
     def place_buffers(obs_unit, obs_scores, valid):
         """The obs buffer replicates over the mesh (single placement
@@ -128,9 +148,15 @@ def fused_tpe(
         return tuple(jax.device_put(a, rep) for a in (obs_unit, obs_scores, valid))
 
     key = jax.random.key(seed)
+    unit0 = np.zeros((M, d), np.float32)
+    scores0 = np.zeros((M,), np.float32)
+    valid0 = np.zeros((M,), bool)
+    if n_warm:
+        unit0[:n_warm] = np.stack([np.asarray(o.unit, np.float32) for o in warm])
+        scores0[:n_warm] = np.array([float(o.score) for o in warm], np.float32)
+        valid0[:n_warm] = True
     obs_unit, obs_scores, valid = place_buffers(
-        jnp.zeros((M, d), jnp.float32), jnp.zeros((M,), jnp.float32),
-        jnp.zeros((M,), bool),
+        jnp.asarray(unit0), jnp.asarray(scores0), jnp.asarray(valid0)
     )
     from mpi_opt_tpu.train.common import HParamsFn
 
@@ -139,7 +165,7 @@ def fused_tpe(
     snap = None
     restored = None
     start_gen = 0
-    done = 0
+    done = n_warm  # write position: live trials append after the priors
     best_curve = []
     member_fail: list = []  # per-gen diverged-suggestion counts
     fails_complete = True
@@ -162,6 +188,10 @@ def fused_tpe(
                 "cfg": dataclasses.asdict(cfg),
                 # carried-state structure (see fused_pbt)
                 "momentum_dtype": momentum_dtype_str(),
+                # the warm prefix is buffer STRUCTURE (its rows shift
+                # every live write position): resuming under a
+                # different prior set must refuse, not corrupt
+                "n_warm": n_warm,
             },
         )
         restored = snap.restore()
@@ -174,7 +204,7 @@ def fused_tpe(
             )
             key = jax.random.wrap_key_data(jnp.asarray(sweep["key_data"]))
             start_gen = int(meta["gens_done"])
-            done = sum(sizes[:start_gen])
+            done = n_warm + sum(sizes[:start_gen])
             best_curve = [float(v) for v in meta["best_curve"]]
             # pre-upgrade snapshots have no per-gen failure tallies for
             # the completed generations: report None, never invent
@@ -192,12 +222,20 @@ def fused_tpe(
     # snapshot records the curve so far). fused_pbt deliberately does
     # NOT defer: its per-launch fetch doubles as the launch-duration
     # barrier that launch-granular wall-to-target accounting needs.
-    defer = snap is None
+    journal = make_fused_journal(ledger, space)
+    journal_require_prefix(journal, start_gen)
+    # a fused journal forces the eager path (its per-batch records must
+    # be fsync-durable before the batch's snapshot — deferral breaks
+    # the ordering contract), same as a checkpoint does
+    defer = snap is None and journal is None
+    # warm prior rows are facts, not trials of THIS sweep: bar them
+    # from the running-best curve and the final winner pick
+    live = jnp.arange(M) >= n_warm
     curve_dev: list = []
     fail_dev: list = []
     try:
         for g in range(start_gen, len(sizes)):
-            obs_unit, obs_scores, valid, key, scores, _ = tpe_generation(
+            obs_unit, obs_scores, valid, key, scores, sugg = tpe_generation(
                 trainer,
                 obs_unit,
                 obs_scores,
@@ -218,7 +256,7 @@ def fused_tpe(
             # would propagate through jnp.max into every later curve
             # point — gate on finiteness too (same rule as best_i below)
             running_dev = jnp.max(
-                jnp.where(valid & jnp.isfinite(obs_scores), obs_scores, -jnp.inf)
+                jnp.where(valid & jnp.isfinite(obs_scores) & live, obs_scores, -jnp.inf)
             )
             # this generation's diverged-suggestion count (ROADMAP open
             # item): the obs ring masks non-finite scores from the model,
@@ -232,6 +270,19 @@ def fused_tpe(
                 # process-spanning (replicated) global array
                 best_curve.append(float(fetch_global(running_dev)))
                 member_fail.append(int(fetch_global(fail_dev_g)))
+            if journal is not None:
+                # one record per suggestion of this batch (members are
+                # the sweep's global trial indices), journaled BEFORE
+                # the generation snapshot below
+                first = sum(sizes[:g])
+                journal_boundary(
+                    journal,
+                    g,
+                    np.arange(first, first + sizes[g]),
+                    fetch_global(sugg),
+                    fetch_global(scores),
+                    step=budget,
+                )
             if snap is not None:
                 # fetch_global for the payload too — np.asarray on the
                 # process-spanning buffers raises, killing the sweep at
@@ -246,6 +297,7 @@ def fused_tpe(
                     },
                     meta_extra={
                         "gens_done": g + 1,
+                        "boundaries_done": g + 1,
                         "best_curve": best_curve,
                         **({"member_fail": member_fail} if fails_complete else {}),
                     },
@@ -269,10 +321,12 @@ def fused_tpe(
         fetched = fetch_global_batched(curve_dev + fail_dev)
         best_curve.extend(float(v) for v in fetched[: len(curve_dev)])
         member_fail.extend(int(v) for v in fetched[len(curve_dev):])
-    np_unit = fetch_global(obs_unit)
-    raw_scores = fetch_global(obs_scores)
+    # warm prior rows are sliced off the returned history: callers get
+    # exactly this sweep's n_trials observations, warm-started or not
+    np_unit = np.asarray(fetch_global(obs_unit))[n_warm:]
+    raw_scores = np.asarray(fetch_global(obs_scores))[n_warm:]
     np_scores = np.asarray(raw_scores)
-    np_valid = fetch_global(valid)
+    np_valid = np.asarray(fetch_global(valid))[n_warm:]
     # invalid rows AND non-finite scores are barred from the winner
     # pick: a valid-but-NaN observation must not win argmax (NaN sorts
     # first). Shared rule: train.common.finite_winner; an all-diverged
@@ -290,4 +344,8 @@ def fused_tpe(
         "obs_unit": np_unit,
         "obs_scores": raw_scores,
         "n_trials": n_trials,
+        "n_warm": n_warm,
+        "journal": None
+        if journal is None
+        else {"written": journal.written, "verified": journal.verified},
     }

@@ -135,6 +135,7 @@ class StagingEngine:
         self._idle = threading.Condition(self._lock)
         self._pending = 0
         self.staged_bytes = 0
+        self.transfers = 0
         self.transfer_s = 0.0
         self.wait_s = 0.0
         self._thread = threading.Thread(
@@ -146,6 +147,8 @@ class StagingEngine:
     # -- worker ----------------------------------------------------------
 
     def _loop(self):
+        from mpi_opt_tpu.health import heartbeat
+
         while True:
             job = self._q.get()
             if job is None:
@@ -160,6 +163,17 @@ class StagingEngine:
                 on_host(host)
                 with self._lock:
                     self.staged_bytes += tree_bytes(host)
+                    self.transfers += 1
+                    n = self.transfers
+                # per-transfer liveness: the main thread parks in
+                # drain() at generation boundaries, so without beats
+                # from HERE a hung host<->device stage (dead tunnel,
+                # wedged runtime) freezes the wave silently until the
+                # whole-generation timeout — with them, launch.py's
+                # --stall-timeout can be sized to one wave's transfer
+                # (heartbeat.beat is thread-safe; no-op when the CLI
+                # configured no heartbeat file)
+                heartbeat.beat(stage="staging transfer", transfers=n)
             except BaseException as e:  # surfaced by drain()
                 with self._lock:
                     self._errors.append(e)

@@ -334,6 +334,8 @@ class SweepCheckpointer:
             saved.setdefault("step_chunk", 0)  # pre-upgrade sweeps were unchunked
         if "wave_size" in self.config:
             saved.setdefault("wave_size", 0)  # pre-upgrade sweeps were resident
+        if "n_warm" in self.config:
+            saved.setdefault("n_warm", 0)  # pre-upgrade TPE sweeps had no priors
         if saved != self.config:
             # name ONLY the mismatched keys: dumping two full config
             # dicts buries the one line that matters (wave_size vs

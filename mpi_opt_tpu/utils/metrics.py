@@ -52,6 +52,11 @@ class MetricsLogger:
         # buffer's whole point, so it must be observable)
         self.staged_bytes = 0
         self.stage_overlap_s = 0.0
+        # fused-ledger counter (ledger/fused.py): member records this
+        # process appended to the boundary-granular journal (verified
+        # re-computations on resume deliberately excluded — they are the
+        # fused twin of `replayed`, carried in the summary's journal dict)
+        self.members_journaled = 0
 
     def log(self, event: str, **fields) -> dict:
         # `t` is relative (this process's clock, for intra-run deltas);
@@ -110,6 +115,10 @@ class MetricsLogger:
         self.staged_bytes += int(staged_bytes)
         self.stage_overlap_s += float(overlap_s)
 
+    def count_journaled(self, n: int = 1):
+        """Fused member records appended to the sweep ledger."""
+        self.members_journaled += int(n)
+
     @property
     def wall(self) -> float:
         return time.perf_counter() - self.t_start
@@ -131,6 +140,7 @@ class MetricsLogger:
             snapshots_quarantined=self.snapshots_quarantined,
             staged_bytes=self.staged_bytes,
             stage_overlap_s=round(self.stage_overlap_s, 3),
+            members_journaled=self.members_journaled,
             wall_s=round(self.wall, 3),
             trials_per_sec_per_chip=round(self.trials_per_sec_per_chip(), 4),
             **extra,

@@ -1,0 +1,56 @@
+"""Measure save-side digest cost: serial vs per-shard parallel hashing.
+
+PR-5 follow-up ("re-measure save-side digest cost on multi-GB pools —
+could digest per-shard async"): utils.integrity.tree_digest now fans
+leaf hashing out over a thread pool when the tree crosses
+MPI_OPT_TPU_DIGEST_PARALLEL_BYTES (hashlib releases the GIL for large
+buffers, so shards hash genuinely parallel). This probe times both
+paths on a synthetic pool shaped like a wave-scheduled population
+(many same-sized param shards) and checks the digests agree.
+
+Run: JAX_PLATFORMS=cpu python probes/probe_digest_cost.py [total_mb]
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+from mpi_opt_tpu.utils import integrity
+
+
+def bench(tree, serial: bool, reps: int = 3) -> float:
+    # the env knob flips the path: an absurd threshold forces serial
+    old = integrity._PARALLEL_DIGEST_BYTES
+    integrity._PARALLEL_DIGEST_BYTES = (1 << 62) if serial else (1 << 20)
+    try:
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            d = integrity.tree_digest(tree)
+            best = min(best, time.perf_counter() - t0)
+        return best, d
+    finally:
+        integrity._PARALLEL_DIGEST_BYTES = old
+
+
+def main():
+    total_mb = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    n_leaves = 16
+    per = total_mb * (1 << 20) // n_leaves // 4
+    rng = np.random.default_rng(0)
+    tree = {f"layer_{i}": rng.standard_normal(per).astype(np.float32) for i in range(n_leaves)}
+    t_serial, d1 = bench(tree, serial=True)
+    t_par, d2 = bench(tree, serial=False)
+    assert d1 == d2, "parallel digest must equal serial"
+    gbps = total_mb / 1024 / t_par
+    print(
+        f"pool={total_mb}MB x {n_leaves} shards  serial={t_serial:.3f}s  "
+        f"parallel={t_par:.3f}s  speedup={t_serial / t_par:.2f}x  "
+        f"({gbps:.2f} GB/s, {os.cpu_count()} cores)"
+    )
+
+
+if __name__ == "__main__":
+    main()

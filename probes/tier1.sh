@@ -10,7 +10,8 @@
 #
 # Usage: probes/tier1.sh            # run + report
 #        T1_LOG=/tmp/my.log probes/tier1.sh   # custom log path
-#        T1_SKIP_FSCK_DRILL=1 probes/tier1.sh # pytest only
+#        T1_SKIP_FSCK_DRILL=1 probes/tier1.sh # skip the fsck drill
+#        T1_SKIP_FUSED_LEDGER_DRILL=1 probes/tier1.sh # skip the ledger drill
 set -o pipefail
 cd "$(dirname "$0")/.."
 T1_LOG="${T1_LOG:-/tmp/_t1.log}"
@@ -48,6 +49,63 @@ if [ -z "$T1_SKIP_FSCK_DRILL" ]; then
         echo "FSCK_DRILL=pass"
     else
         echo "FSCK_DRILL=FAIL"
+        rc=$(( rc == 0 ? 1 : rc ))
+    fi
+fi
+
+# -- fused-ledger drill (boundary-granular durability, ledger/fused.py) --
+# A fused TPE sweep is hard-killed MID-JOURNAL of its second batch (the
+# real append-kill shape: boundary 1 half-written), then:
+#   fsck --ledger must FLAG the torn boundary (exit 1),
+#   fsck --repair truncates it (and quarantines any torn snapshot step),
+#   --resume re-trains only the incomplete boundary (verifying the
+#   completed one against its records) and re-journals it,
+#   report --validate and fsck --ledger must then exit 0.
+if [ -z "$T1_SKIP_FUSED_LEDGER_DRILL" ]; then
+    fl_rc=0
+    FD=$(mktemp -d /tmp/_t1_fled.XXXXXX)
+    fused_sweep() {
+        timeout -k 10 180 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+            --workload fashion_mlp --algorithm tpe --fused --no-mesh \
+            --trials 6 --population 3 --budget 2 --seed 0 \
+            --checkpoint-dir "$FD/ck" --ledger "$FD/sweep.jsonl" \
+            "$@" >/dev/null 2>&1
+    }
+    ledger_fsck() {
+        timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+            fsck "$FD/ck" --ledger "$FD/sweep.jsonl" "$@" >/dev/null 2>&1
+    }
+    # kill the sweep after 1 member record of boundary 1 hit the disk
+    timeout -k 10 180 env JAX_PLATFORMS=cpu python - "$FD" >/dev/null 2>&1 <<'PYEOF'
+import os, sys
+import mpi_opt_tpu.ledger.store as ls
+orig = ls.SweepLedger._write_line
+n = [0]
+def dying_write(self, rec):
+    orig(self, rec)
+    n[0] += 1
+    if n[0] == 5:  # header + batch 0's 3 records + 1 of batch 1: die
+        os._exit(137)
+ls.SweepLedger._write_line = dying_write
+from mpi_opt_tpu.cli import main
+d = sys.argv[1]
+main(["--workload", "fashion_mlp", "--algorithm", "tpe", "--fused",
+      "--no-mesh", "--trials", "6", "--population", "3", "--budget", "2",
+      "--seed", "0", "--checkpoint-dir", f"{d}/ck",
+      "--ledger", f"{d}/sweep.jsonl"])
+PYEOF
+    [ $? -eq 137 ] || fl_rc=1                 # the kill must have landed
+    ledger_fsck; [ $? -eq 1 ] || fl_rc=1      # torn boundary must be FLAGGED
+    ledger_fsck --repair; [ $? -eq 1 ] || fl_rc=1  # found + repaired contract
+    fused_sweep --resume || fl_rc=1           # verify prefix + re-journal
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        report --validate "$FD/sweep.jsonl" >/dev/null 2>&1 || fl_rc=1
+    ledger_fsck || fl_rc=1                    # post-recovery audit is clean
+    rm -rf "$FD"
+    if [ $fl_rc -eq 0 ]; then
+        echo "FUSED_LEDGER_DRILL=pass"
+    else
+        echo "FUSED_LEDGER_DRILL=FAIL"
         rc=$(( rc == 0 ? 1 : rc ))
     fi
 fi

@@ -664,11 +664,6 @@ def test_cli_ledger_flag_validation(capsys, tmp_path):
     led = str(tmp_path / "l.jsonl")
     for argv, msg in (
         (
-            ["--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
-             "--population", "4", "--generations", "1", "--ledger", led],
-            "per-trial host loop",
-        ),
-        (
             ["--workload", "quadratic", "--trials", "2",
              "--ledger", led, "--warm-start", led],
             "PRIOR sweep",
@@ -677,6 +672,13 @@ def test_cli_ledger_flag_validation(capsys, tmp_path):
             # a path ALIAS of the same file is still self-feeding
             ["--workload", "quadratic", "--trials", "2", "--ledger", led,
              "--warm-start", str(tmp_path / "." / "l.jsonl")],
+            "PRIOR sweep",
+        ),
+        (
+            # the self-feed guard is mode-independent (fused included)
+            ["--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+             "--population", "4", "--generations", "1", "--ledger", led,
+             "--warm-start", led],
             "PRIOR sweep",
         ),
     ):
@@ -1043,3 +1045,164 @@ def test_cli_fused_diverged_summary_is_strict_json(capsys, monkeypatch):
     assert summary["best_score"] is None
     assert summary["best_params"] is None
     assert summary["best_curve"] == [0.5, None]
+
+
+# -- fused-path ledger durability (ISSUE 6) --------------------------------
+
+
+def test_cli_fused_ledger_preempt_resume_journal_identical(capsys, tmp_path, monkeypatch):
+    """The fused acceptance drill end-to-end: a preempted --fused
+    --ledger sweep exits 75 with the completed generation journaled;
+    --resume re-trains only the incomplete generation; the final
+    journal is record-identical to an unkilled run's and passes both
+    `report --validate` and summary accounting."""
+    from mpi_opt_tpu.health import shutdown as shutdown_mod
+    from mpi_opt_tpu.ledger.report import report_main
+
+    clean_led = str(tmp_path / "clean.jsonl")
+    base = [
+        "--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+        "--population", "4", "--generations", "2",
+        "--steps-per-generation", "2", "--gen-chunk", "1", "--no-mesh",
+        "--seed", "0",
+    ]
+    assert main(base + ["--ledger", clean_led]) == 0
+    clean = _summary(capsys)
+    assert clean["journal"] == {"written": 8, "verified": 0}
+
+    led = str(tmp_path / "sweep.jsonl")
+    ck = str(tmp_path / "ck")
+    drill = base + ["--ledger", led, "--checkpoint-dir", ck]
+    # drain at the FIRST boundary (the final boundary suppresses the
+    # poll, so a 2-generation sweep has exactly one drain point) — the
+    # generation's members are journaled BEFORE the drain honors the flag
+    monkeypatch.setattr(shutdown_mod, "requested", lambda: True)
+    monkeypatch.setattr(shutdown_mod, "active_signal", lambda: "SIGTERM")
+    assert main(drill) == 75
+    out = capsys.readouterr().out
+    assert '"preempted": true' in out
+    # generation 0's members were journaled before the drain
+    assert len(open(led).read().splitlines()) == 1 + 4
+    monkeypatch.undo()
+
+    assert main(drill + ["--resume"]) == 0
+    resumed = _summary(capsys)
+    # only the incomplete generation re-journals; nothing re-verifies
+    # (the completed one was never re-computed — its snapshot replayed)
+    assert resumed["journal"] == {"written": 4, "verified": 0}
+    assert resumed["best_score"] == clean["best_score"]
+
+    def records(path):
+        keep = ("trial_id", "member", "boundary", "boundary_size", "params",
+                "status", "score", "step")
+        return [
+            {k: r[k] for k in keep}
+            for r in map(json.loads, open(path).read().splitlines()[1:])
+        ]
+
+    assert records(led) == records(clean_led)
+    assert report_main(["--validate", led, clean_led]) == 0
+    capsys.readouterr()
+
+
+def test_cli_fused_ledger_kill_fsck_repair_resume_cycle(capsys, tmp_path):
+    """The tier-1 drill's state machine, in-process: a mid-journal kill
+    leaves a torn final boundary + a snapshot at the previous one; fsck
+    --ledger flags it (exit 1), --resume self-heals and re-journals,
+    and the post-recovery audit is clean (validate + fsck exit 0)."""
+    import shutil
+
+    from mpi_opt_tpu.ledger.report import report_main
+    from mpi_opt_tpu.utils.integrity import fsck_main
+
+    led = str(tmp_path / "sweep.jsonl")
+    ck = str(tmp_path / "ck")
+    argv = [
+        "--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+        "--population", "4", "--generations", "2",
+        "--steps-per-generation", "2", "--gen-chunk", "1", "--no-mesh",
+        "--seed", "0", "--ledger", led, "--checkpoint-dir", ck,
+    ]
+    assert main(argv) == 0
+    clean_lines = open(led).read().splitlines()
+    capsys.readouterr()
+
+    # reconstruct the kill-mid-journal state: boundary 1 half-written
+    # (2 of 4 records), and the snapshot that would have covered it
+    # never committed — exactly what dying between record 6 and 7 leaves
+    open(led, "w").write("\n".join(clean_lines[:7]) + "\n")
+    shutil.rmtree(os.path.join(ck, "2"))
+
+    assert fsck_main([ck, "--ledger", led]) == 1  # torn boundary FLAGGED
+    out = capsys.readouterr().out
+    assert "torn" in out
+    assert main(argv + ["--resume"]) == 0  # heals + verifies + re-journals
+    capsys.readouterr()
+
+    # the healed + re-journaled ledger carries the clean run's exact
+    # record content (only timestamps may differ)
+    def strip_ts(lines):
+        return [
+            {k: v for k, v in json.loads(l).items() if k != "ts"}
+            for l in lines
+        ]
+
+    assert strip_ts(open(led).read().splitlines()) == strip_ts(clean_lines)
+    assert report_main(["--validate", led]) == 0
+    assert fsck_main([ck, "--ledger", led]) == 0  # post-recovery audit clean
+    capsys.readouterr()
+
+
+def test_cli_fused_ledger_divergence_exits_data_error(capsys, tmp_path):
+    """A journal whose scores belong to a DIFFERENT trajectory is a
+    data dead-end: the resume's boundary verification raises and the
+    CLI exits 65 (non-retryable), never silently re-writing history."""
+    led = str(tmp_path / "sweep.jsonl")
+    argv = [
+        "--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+        "--population", "4", "--generations", "1",
+        "--steps-per-generation", "2", "--no-mesh", "--seed", "0",
+        "--ledger", led,
+    ]
+    assert main(argv) == 0
+    capsys.readouterr()
+    lines = open(led).read().splitlines()
+    rec = json.loads(lines[1])
+    rec["score"] = 0.123456  # a score this seed never produced
+    lines[1] = json.dumps(rec)
+    open(led, "w").write("\n".join(lines) + "\n")
+    assert main(argv + ["--resume"]) == 65
+    out = capsys.readouterr().out
+    assert '"data_error"' in out and "diverges" in out
+
+
+def test_cli_fused_warm_start_cross_mode(capsys, tmp_path):
+    """--warm-start with --fused: a prior ledger (either mode) seeds
+    the fused sweep; refusal happens ONLY on space-hash mismatch."""
+    prior = str(tmp_path / "prior.jsonl")
+    assert main([
+        "--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+        "--population", "4", "--generations", "1",
+        "--steps-per-generation", "2", "--no-mesh", "--seed", "0",
+        "--ledger", prior,
+    ]) == 0
+    capsys.readouterr()
+    fused_tpe = [
+        "--workload", "fashion_mlp", "--algorithm", "tpe", "--fused",
+        "--trials", "4", "--population", "2", "--budget", "2", "--no-mesh",
+        "--seed", "1", "--warm-start", prior,
+    ]
+    assert main(fused_tpe) == 0
+    out = capsys.readouterr().out
+    assert '"event": "warm_start"' in out and '"observations": 4' in out
+
+    # forge a foreign space hash: the SAME file now refuses — proving
+    # the gate is the space, not the mode
+    lines = open(prior).read().splitlines()
+    hdr = json.loads(lines[0])
+    hdr["config"]["space_hash"] = "feedfacefeedface"
+    open(prior, "w").write("\n".join([json.dumps(hdr)] + lines[1:]) + "\n")
+    with pytest.raises(SystemExit) as exc:
+        main(fused_tpe)
+    assert exc.value.code == 2
+    assert "space hash" in capsys.readouterr().err

@@ -349,3 +349,73 @@ def test_estimate_wave_size_respects_budget_and_population(wl):
     member = 2 * tree_bytes(params_sd)  # params + f32 momentum
     w = estimate_wave_size(trainer, tx[:2], 8, budget_bytes=int(member * 2 / 0.35))
     assert 1 <= w <= 2
+
+
+def test_staging_engine_beats_heartbeat_per_transfer(tmp_path):
+    """ISSUE 6 satellite: the background transfer thread beats the rank
+    heartbeat per completed transfer, so a hung host<->device stage is
+    caught by --stall-timeout instead of freezing a wave silently while
+    the main thread parks in drain()."""
+    import jax.numpy as jnp
+
+    from mpi_opt_tpu.health import heartbeat
+    from mpi_opt_tpu.train import staging
+
+    hb_path = str(tmp_path / "rank.hb")
+    heartbeat.configure(hb_path)
+    try:
+        eng = staging.StagingEngine()
+        try:
+            for _ in range(3):
+                eng.stage_out({"x": jnp.ones((8,))}, lambda host: None)
+            eng.drain()
+        finally:
+            eng.close()
+        rec = heartbeat.read_beat(hb_path)
+        assert rec is not None and rec["beats"] >= 3
+        assert rec["progress"]["stage"] == "staging transfer"
+        assert rec["progress"]["transfers"] == 3
+        assert eng.transfers == 3
+    finally:
+        heartbeat.deconfigure()
+
+
+def test_wave_journal_identical_to_resident(tmp_path):
+    """Wave scheduling is bit-identical to resident mode, so one ledger
+    records the same trajectory either way: the journaled record sets
+    (ids, members, boundaries, params, scores) must be EQUAL — which is
+    also why wave_size is deliberately not ledger identity."""
+    import json
+
+    from mpi_opt_tpu.ledger import SweepLedger, validate_ledger
+
+    wl = get_workload("fashion_mlp", n_train=256, n_val=128)
+    space = wl.default_space()
+    kw = dict(population=6, generations=2, steps_per_gen=3, seed=2)
+
+    def run(path, wave_size):
+        led = SweepLedger(path)
+        led.ensure_header(
+            {"mode": "fused", "granularity": "generation", "algorithm": "pbt",
+             "seed": kw["seed"], "space_hash": space.space_hash()}
+        )
+        res = fp.fused_pbt(wl, wave_size=wave_size, ledger=led, **kw)
+        led.close()
+        return res
+
+    resident = str(tmp_path / "resident.jsonl")
+    waved = str(tmp_path / "waved.jsonl")
+    r_res = run(resident, wave_size=0)
+    r_wav = run(waved, wave_size=4)  # 2 waves, non-dividing split
+    assert r_res["journal"]["written"] == r_wav["journal"]["written"] == 12
+    assert validate_ledger(resident) == [] and validate_ledger(waved) == []
+
+    def records(path):
+        keep = ("trial_id", "member", "boundary", "boundary_size", "params",
+                "status", "score", "step")
+        return [
+            {k: r[k] for k in keep}
+            for r in map(json.loads, open(path).read().splitlines()[1:])
+        ]
+
+    assert records(resident) == records(waved)

@@ -352,3 +352,76 @@ def test_fsck_repairs_torn_ledger_tail_and_gates_on_explicit_only(tmp_path, caps
 
     assert integrity.fsck_main([d, "--json", "--ledger", led]) == 0
     capsys.readouterr()
+
+
+# -- per-shard parallel save digests (ISSUE 6 satellite) -------------------
+
+
+def test_parallel_digest_equals_serial(monkeypatch):
+    """The thread-pool leaf-hashing path must produce the EXACT digest
+    the serial path does (per-leaf digests combine in sorted path
+    order) — snapshots written on multi-core hosts verify on 1-core
+    ones and vice versa."""
+    rng = np.random.default_rng(0)
+    tree = {f"shard_{i}": rng.standard_normal(4096).astype(np.float32) for i in range(6)}
+    serial = integrity.tree_digest(tree)  # far below the threshold
+    monkeypatch.setattr(integrity, "_PARALLEL_DIGEST_BYTES", 1)
+    assert integrity.tree_digest(tree) == serial
+
+
+def test_parallel_digest_unverifiable_leaf_still_returns_none(monkeypatch):
+    class Opaque:
+        shape = ()
+        dtype = "float32"
+
+    monkeypatch.setattr(integrity, "_PARALLEL_DIGEST_BYTES", 1)
+    monkeypatch.setattr(integrity, "_leaf_digest", lambda l: None)
+    assert integrity.tree_digest({"a": np.ones(4), "b": np.ones(4)}) is None
+
+
+# -- fsck --deep: ocdbt-internal checksums (ISSUE 6 satellite) -------------
+
+
+def _rot_nested_process_store(step_dir):
+    """Flip one bit in a nested ocdbt.process_* data file — the rot
+    shape a plain restore (and therefore the manifest layer) reads
+    straight past, because restores resolve through the top-level
+    database."""
+    import glob
+
+    files = sorted(
+        glob.glob(os.path.join(step_dir, "*", "ocdbt.process_*", "d", "*")),
+        key=os.path.getsize,
+    )
+    assert files, "expected nested ocdbt process-store data files"
+    tgt = files[-1]
+    raw = bytearray(open(tgt, "rb").read())
+    raw[len(raw) // 2] ^= 0x40
+    open(tgt, "wb").write(bytes(raw))
+    return tgt
+
+
+def test_fsck_deep_catches_ocdbt_internal_rot(tmp_path, capsys):
+    from mpi_opt_tpu.utils.integrity import fsck_main
+
+    ck = str(tmp_path / "ck")
+    snap = SweepCheckpointer(ck, {"a": 1})
+    snap.save(1, sweep={"x": np.arange(64.0), "y": np.ones((16, 16), np.float32)},
+              meta_extra={"m": 2})
+    snap.close()
+    assert fsck_main([ck, "--deep"]) == 0  # clean tree audits clean, deeply
+    capsys.readouterr()
+    _rot_nested_process_store(os.path.join(ck, "1"))
+    # the manifest layer verifies what a restore RETURNS — it passes
+    assert fsck_main([ck]) == 0
+    capsys.readouterr()
+    # --deep reads every ocdbt key back: tensorstore's CRC-32C flags it
+    assert fsck_main([ck, "--deep", "--json"]) == 1
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    (entry,) = report["steps"]
+    assert entry["status"] == "corrupt"
+    assert any("CRC" in p or "ocdbt" in p for p in entry["problems"])
+    # --deep --repair quarantines it like any other corrupt step
+    assert fsck_main([ck, "--deep", "--repair"]) == 1
+    capsys.readouterr()
+    assert integrity.list_quarantined(ck)

@@ -153,3 +153,15 @@ def test_summary_includes_snapshot_quarantine_counter():
     m.count_quarantined(2)
     assert m.summary()["snapshots_quarantined"] == 3
     assert MetricsLogger().summary()["snapshots_quarantined"] == 0
+
+
+def test_summary_includes_members_journaled():
+    """members_journaled (fused-ledger member records appended) reaches
+    the metrics summary; zero-valued when no fused journaling ran."""
+    from mpi_opt_tpu.utils.metrics import MetricsLogger
+
+    m = MetricsLogger()
+    m.count_journaled(8)
+    m.count_journaled(4)
+    assert m.summary()["members_journaled"] == 12
+    assert MetricsLogger().summary()["members_journaled"] == 0
