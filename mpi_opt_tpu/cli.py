@@ -18,12 +18,13 @@ import sys
 from mpi_opt_tpu.algorithms import ALGORITHMS, get_algorithm
 from mpi_opt_tpu.backends import available_backends, get_backend
 from mpi_opt_tpu.driver import run_search
-from mpi_opt_tpu.health import EX_TEMPFAIL, SweepInterrupted
+from mpi_opt_tpu.health import SweepInterrupted
 from mpi_opt_tpu.health import heartbeat as _heartbeat
 from mpi_opt_tpu.health import shutdown as _shutdown
 from mpi_opt_tpu.ops.pbt import PBTConfig
 from mpi_opt_tpu.utils import integrity
-from mpi_opt_tpu.utils.integrity import EX_DATAERR, NoVerifiedSnapshotError
+from mpi_opt_tpu.utils.exitcodes import EX_DATAERR, EX_TEMPFAIL
+from mpi_opt_tpu.utils.integrity import NoVerifiedSnapshotError
 from mpi_opt_tpu.utils.metrics import stdout_logger
 from mpi_opt_tpu.workloads import available, get_workload
 
@@ -57,6 +58,57 @@ def _data_error_exit(e, metrics, **summary_fields) -> int:
         file=sys.stderr,
     )
     return EX_DATAERR
+
+
+def wire_compile_cache() -> bool:
+    """ROADMAP's "kill warmup" lever: point jax's persistent compilation
+    cache at ``$MPI_OPT_TPU_CACHE_DIR`` so repeat sweeps, supervisor
+    restarts, and every service tenant whose programs were ever
+    compiled on this machine skip XLA compilation entirely (the
+    140–210 s warmup measured in BENCH_r01–r05 becomes a disk read).
+
+    Called BEFORE backend init on every sweep path (and inherited by
+    launch.py's rank processes via their environment). Opt-in by env
+    var because cache artifacts carry machine features: a shared dir
+    crossing machines trips mismatch errors (PERF_NOTES round 4) — the
+    CPU pool workers' separate ``MPI_OPT_TPU_CPU_CACHE_DIR`` default
+    (backends/cpu.py) stays platform-split for the same reason."""
+    import os
+
+    cache = os.environ.get("MPI_OPT_TPU_CACHE_DIR")
+    if not cache:
+        return False
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache)
+    return True
+
+
+def pin_platform(platform, local_devices, error) -> None:
+    """Validate and apply the pre-backend-init platform pin — the ONE
+    implementation for the flat CLI and ``serve`` bring-up (``error`` is
+    ``parser.error``-shaped: prints usage and exits 2). Must run before
+    anything touches the XLA backend."""
+    if platform is None and local_devices is None:
+        return
+    if local_devices is not None:
+        if platform != "cpu":
+            error("--local-devices requires --platform cpu")
+        if local_devices < 1:
+            error(f"--local-devices must be >= 1, got {local_devices}")
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platform)
+        if local_devices is not None:
+            from mpi_opt_tpu.utils.hostdev import request_cpu_devices
+
+            request_cpu_devices(local_devices)
+    except RuntimeError as e:
+        error(
+            f"--platform/--local-devices must be set before any JAX "
+            f"use in this process: {e}"
+        )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -872,7 +924,13 @@ def _run_fused_dispatch(
     return 0
 
 
-def main(argv=None) -> int:
+def main(argv=None, *, _workload=None) -> int:
+    """CLI entrypoint. ``_workload`` is the sweep service's injection
+    seam (service/programs.py): a resident server passes its cached
+    workload instance so back-to-back tenants share trainers — and with
+    them jax's in-process jit cache, making a shape-matching tenant's
+    marginal cost dispatch instead of compile. None (every normal
+    invocation) resolves the workload from the registry as always."""
     if argv is None:
         argv = sys.argv[1:]
     # subcommand dispatch: `mpi_opt_tpu report ...` renders/validates
@@ -889,6 +947,13 @@ def main(argv=None) -> int:
         from mpi_opt_tpu.utils.integrity import fsck_main
 
         return fsck_main(argv[1:])
+    # the resident multi-tenant sweep service (service/): `serve` is the
+    # long-lived device-owning server, `submit`/`status`/`cancel`/`drain`
+    # are the thin filesystem-spool clients (no network dependency)
+    if argv and argv[0] in ("serve", "submit", "status", "cancel", "drain"):
+        from mpi_opt_tpu.service import service_main
+
+        return service_main(argv)
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.resume and not (args.checkpoint_dir or args.ledger):
@@ -947,30 +1012,11 @@ def main(argv=None) -> int:
                 "--warm-start must name a PRIOR sweep's ledger, not this "
                 "run's --ledger (resuming this sweep is --ledger --resume)"
             )
-    # platform pinning, then multi-host bring-up, BEFORE anything
-    # touches the XLA backend (build_mesh, workload data, backend
-    # construction all do) — both are only possible pre-initialization
-    if args.platform is not None or args.local_devices is not None:
-        if args.local_devices is not None:
-            if args.platform != "cpu":
-                parser.error("--local-devices requires --platform cpu")
-            if args.local_devices < 1:
-                parser.error(
-                    f"--local-devices must be >= 1, got {args.local_devices}"
-                )
-        import jax
-
-        try:
-            jax.config.update("jax_platforms", args.platform)
-            if args.local_devices is not None:
-                from mpi_opt_tpu.utils.hostdev import request_cpu_devices
-
-                request_cpu_devices(args.local_devices)
-        except RuntimeError as e:
-            parser.error(
-                f"--platform/--local-devices must be set before any JAX "
-                f"use in this process: {e}"
-            )
+    # persistent compile cache (env-gated), then platform pinning, then
+    # multi-host bring-up, BEFORE anything touches the XLA backend
+    # (build_mesh, workload data, backend construction all do)
+    wire_compile_cache()
+    pin_platform(args.platform, args.local_devices, parser.error)
     explicit = (args.coordinator, args.num_processes, args.process_id)
     if any(v is not None for v in explicit) and not all(
         v is not None for v in explicit
@@ -1009,16 +1055,19 @@ def main(argv=None) -> int:
         with _shutdown.ShutdownGuard():
             if args.heartbeat_file:
                 _heartbeat.configure(args.heartbeat_file)
-            return _run_sweep(args, parser)
+            return _run_sweep(args, parser, _workload=_workload)
     finally:
         _heartbeat.deconfigure()
         integrity.clear_observer()
 
 
-def _run_sweep(args, parser) -> int:
+def _run_sweep(args, parser, _workload=None) -> int:
     """The sweep body of ``main`` (split out so the shutdown guard and
     heartbeat lifecycle wrap every path)."""
-    workload = get_workload(args.workload)
+    # the service's shared instance when injected; --chaos still wraps
+    # below (the wrapper is built fresh by name, so injection never
+    # leaks one tenant's fault schedule into another)
+    workload = _workload if _workload is not None else get_workload(args.workload)
     chaos_kwargs = None
     if args.chaos is not None:
         if args.fused or args.backend != "cpu":

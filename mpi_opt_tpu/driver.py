@@ -314,6 +314,17 @@ def run_search(
         # launch supervisor's stall watchdog times the gaps between
         # these). No-op unless the process configured --heartbeat-file.
         heartbeat.beat(stage="driver", batches=batches, trials=algorithm.n_trials)
+        # cooperative-slice point (the driver-path twin of the fused
+        # launch_boundary's): a service slice hook may set the drain
+        # flag this very boundary honors. Only batches that EVALUATED
+        # something tick the hook — a replay/cache-served batch costs no
+        # device time, and counting it would livelock a resumed slice
+        # (every slice re-replays the journal, spends its whole budget
+        # on free batches, and parks with zero new progress, forever).
+        # A finished sweep never drains, matching the fused final=True
+        # rule below.
+        if pending and not algorithm.finished():
+            shutdown.poll_slice(f"batch {batches}")
         if shutdown.requested() and not algorithm.finished():
             # graceful-shutdown drain point: the in-flight batch is done
             # and journaled (the ledger fsyncs per record); force an

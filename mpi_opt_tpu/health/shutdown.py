@@ -30,12 +30,11 @@ from __future__ import annotations
 
 import signal
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
-# sysexits.h EX_TEMPFAIL: "temporary failure, user is invited to retry".
-# The one exit code in the launch supervisor's contract that means
-# "restart me with --resume, and don't bill the retry budget".
-EX_TEMPFAIL = 75
+# re-export (utils/exitcodes.py is the one home for the code values;
+# the historical import surface `health.shutdown.EX_TEMPFAIL` stays)
+from mpi_opt_tpu.utils.exitcodes import EX_TEMPFAIL  # noqa: F401
 
 
 class SweepInterrupted(RuntimeError):
@@ -73,14 +72,33 @@ class ShutdownGuard:
         self.installed = False
         self._prev: dict = {}
         self._outer: Optional[ShutdownGuard] = None
+        self._signal_seen = False
 
     def _handle(self, signum, frame):
-        if self.requested and signum == signal.SIGINT:
-            # second Ctrl-C: the user wants out NOW, not after the batch
+        global _DELIVERED
+        name = signal.Signals(signum).name
+        # record every REAL signal delivery at module level: nested
+        # guards (the sweep service runs each tenant slice under its
+        # own guard inside the server's) consume the flag with the
+        # inner guard, but the server still needs to know, after the
+        # slice returns, whether the drain it observed was its own
+        # cooperative time-slice or the platform telling the whole
+        # process to die
+        _DELIVERED = name
+        if self._signal_seen and signum == signal.SIGINT:
+            # a REAL signal already arrived and now Ctrl-C: the user
+            # wants out NOW, not after the batch. Keyed on delivered
+            # signals, NOT self.requested — a programmatic slice/cancel
+            # request() must not turn the user's FIRST Ctrl-C into a
+            # mid-step KeyboardInterrupt that skips the drain
             raise KeyboardInterrupt
+        self._signal_seen = True
         self.requested = True
-        if self.signal_name is None:
-            self.signal_name = signal.Signals(signum).name
+        # a real signal outranks a programmatic slice request: the
+        # supervisor/platform asked the PROCESS to stop, and the exit
+        # summary should say so even if a slice fired first
+        if self.signal_name is None or self.signal_name == SLICE:
+            self.signal_name = name
 
     def __enter__(self) -> "ShutdownGuard":
         global _ACTIVE
@@ -109,3 +127,78 @@ def requested() -> bool:
 
 def active_signal() -> Optional[str]:
     return None if _ACTIVE is None else _ACTIVE.signal_name
+
+
+# -- scoped programmatic drain requests (the sweep service's time-slice) --
+#
+# The service preempts a running tenant by the SAME mechanism a platform
+# SIGTERM uses: set the active guard's drain flag and let the sweep's
+# next natural boundary (gen_chunk / rung / TPE batch / wave — the
+# launch_boundary call sites; the driver's batch boundary) flush its
+# snapshot and raise SweepInterrupted. A time-sliced sweep therefore
+# leaves EXACTLY the durable state a preempted one does, which is why a
+# parked tenant's ledger is bit-identical to an uninterrupted run's.
+# The request is scoped to the active guard: when the slice's guard
+# exits, the flag dies with it and nothing leaks to the next tenant.
+
+#: the pseudo-signal name a cooperative time-slice drain reports
+SLICE = "SLICE"
+
+#: the most recent REAL signal delivered to a guard's handler in this
+#: process (None until one arrives); survives guard exit so a scheduler
+#: can distinguish "my slice expired" from "the platform killed us"
+_DELIVERED: Optional[str] = None
+
+#: scheduler-installed per-boundary callback (see set_slice_hook)
+_SLICE_HOOK: Optional[Callable[[str], None]] = None
+
+
+def request(source: str = SLICE) -> bool:
+    """Programmatically request a graceful drain on the active guard.
+
+    Returns False (no-op) when no guard is active. A real signal name
+    already recorded is never overwritten — the platform's SIGTERM
+    outranks a slice."""
+    if _ACTIVE is None:
+        return False
+    if _ACTIVE.signal_name is None:
+        _ACTIVE.signal_name = source
+    _ACTIVE.requested = True
+    return True
+
+
+def delivered_signal() -> Optional[str]:
+    """The most recent REAL signal a guard handler received in this
+    process, or None. Unlike ``active_signal`` this survives guard
+    exit; clear it with ``clear_delivered`` before the window you want
+    to observe."""
+    return _DELIVERED
+
+
+def clear_delivered() -> None:
+    global _DELIVERED
+    _DELIVERED = None
+
+
+def set_slice_hook(fn: Optional[Callable[[str], None]]) -> None:
+    """Install the scheduler's cooperative-slice callback.
+
+    ``fn(stage)`` is invoked from every non-final drain point
+    (``train.common.launch_boundary``, the driver's batch boundary)
+    BEFORE the drain flag is checked, so a hook that decides the slice
+    budget is spent can ``request()`` and have the very same boundary
+    honor it. The hook must be cheap and must not raise — it runs on
+    the sweep's hot host path."""
+    global _SLICE_HOOK
+    _SLICE_HOOK = fn
+
+
+def clear_slice_hook() -> None:
+    set_slice_hook(None)
+
+
+def poll_slice(stage: str) -> None:
+    """Drain points' service call: give an installed slice hook its
+    per-boundary look (no-op without one)."""
+    if _SLICE_HOOK is not None:
+        _SLICE_HOOK(stage)

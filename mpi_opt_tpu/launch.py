@@ -79,9 +79,9 @@ import sys
 import tempfile
 import time
 
-from mpi_opt_tpu.health.shutdown import EX_TEMPFAIL, ShutdownGuard
+from mpi_opt_tpu.health.shutdown import ShutdownGuard
 from mpi_opt_tpu.health.watchdog import StallDetector
-from mpi_opt_tpu.utils.integrity import EX_DATAERR
+from mpi_opt_tpu.utils.exitcodes import EX_DATAERR, EX_TEMPFAIL, EX_USAGE
 
 
 def _backoff_s(attempt: int, base: float, jitter: float, rng: random.Random) -> float:
@@ -112,6 +112,11 @@ def _spawn_ranks(n: int, rest: list[str], log_dir: str, heartbeat: bool = False)
     ``heartbeat`` each rank gets ``--heartbeat-file`` pointed at its
     per-rank file under ``log_dir`` (the stall watchdog's input)."""
     port = _free_port()
+    # rank env is INHERITED (Popen env=None): MPI_OPT_TPU_CACHE_DIR
+    # reaches every restart/resume attempt of every rank, where
+    # cli.wire_compile_cache reads it before backend init — a
+    # preemption-resume cycle pays a disk read, not the 140–210 s
+    # recompile warmup
     procs = []
     # incremental build + cleanup-on-failure: if Popen dies mid-loop
     # (fork EAGAIN, interpreter gone), the already-spawned ranks would
@@ -577,7 +582,7 @@ def main(argv=None) -> int:
                     f"Stderr:\n{tail}\n"
                 )
                 return 1
-            if rc == 2:
+            if rc == EX_USAGE:
                 # argparse usage error: deterministic, and retrying would be
                 # actively wrong — e.g. the CLI's stale-checkpoint-dir
                 # refusal (exit 2) would be "recovered" by the retry's

@@ -1,21 +1,31 @@
 """Post-hoc sweep reporting: render one-or-many ledgers for operators.
 
-``python -m mpi_opt_tpu report LEDGER [LEDGER ...]`` — best trial,
+``python -m mpi_opt_tpu report TARGET [TARGET ...]`` — best trial,
 score trajectory, failure/timeout/retry/cache breakdown, throughput;
 ``--json`` for machines, ``--validate`` as the CI schema gate (exit 1
 on any malformed record, torn tail included — format drift should be
 caught by the suite, not by a resume failure in production).
+
+A TARGET may be a DIRECTORY: every ledger underneath is discovered
+(header-sniffed ``*.jsonl``) and rendered grouped by sweep identity —
+pointed at a service ``--state-dir``, one command audits every
+tenant's best/status/throughput, with each tenant's service state
+(done/parked/cancelled, slices) read from the sibling ``status.json``
+the scheduler maintains.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
 from typing import Optional
 
 from mpi_opt_tpu.ledger.store import (
     LedgerError,
     read_ledger,
     scan_boundaries,
+    sniff_header,
     validate_ledger,
 )
 
@@ -40,6 +50,45 @@ def _sparkline(values: list[float], width: int = 32) -> str:
         else:
             out.append(_SPARK[int((v - lo) / span * (len(_SPARK) - 1))])
     return "".join(out)
+
+
+def discover_ledgers(directory: str) -> list[str]:
+    """Every ledger file under ``directory``: ``*.jsonl`` whose first
+    line is a ledger header record (``store.sniff_header``). Metrics
+    streams (JSONL of ``{"event": ...}``) and other JSON files are
+    skipped by the sniff, so pointing this at a service state-dir finds
+    exactly the per-tenant journals."""
+    found = []
+    for root, _dirs, files in os.walk(directory):
+        for f in files:
+            if not f.endswith(".jsonl"):
+                continue
+            path = os.path.join(root, f)
+            if sniff_header(path) is not None:
+                found.append(path)
+    return sorted(found)
+
+
+def _service_status(path: str) -> Optional[dict]:
+    """The scheduler-maintained tenant status next to a service ledger
+    (None for plain CLI ledgers): operators reading a state-dir report
+    want done/parked/cancelled and slice counts beside the scores."""
+    status_path = os.path.join(os.path.dirname(path), "status.json")
+    try:
+        with open(status_path) as f:
+            s = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(s, dict) or "state" not in s:
+        return None
+    return {
+        "job": s.get("id"),
+        "tenant": s.get("tenant"),
+        "state": s.get("state"),
+        "slices": s.get("slices"),
+        "preemptions": s.get("preemptions"),
+        "program_cache": s.get("program_cache"),
+    }
 
 
 def summarize_ledger(path: str) -> dict:
@@ -117,6 +166,7 @@ def summarize_ledger(path: str) -> dict:
         "trials_per_sec": round(n / span, 4) if span > 0 else None,
         "eval_wall_s": round(wall_sum, 3),
         "fused": fused,
+        "service": _service_status(path),
     }
 
 
@@ -135,6 +185,15 @@ def _render_text(rep: dict) -> str:
         f"timeout={rep['by_status']['timeout']} retried={rep['retried']} "
         f"cache_hits={rep['cache_hits']}",
     ]
+    if rep.get("service"):
+        s = rep["service"]
+        pc = s.get("program_cache") or {}
+        lines.append(
+            f"  service: tenant={s.get('tenant')} job={s.get('job')} "
+            f"state={s.get('state')} slices={s.get('slices')} "
+            f"preemptions={s.get('preemptions')} "
+            f"cache={pc.get('hits', 0)}h/{pc.get('misses', 0)}m"
+        )
     if rep["torn_tail_dropped"]:
         lines.append("  note: 1 torn tail line dropped (crash mid-append)")
     if rep.get("fused"):
@@ -246,7 +305,14 @@ def report_main(argv=None) -> int:
         prog="mpi_opt_tpu report",
         description="render durable sweep ledgers (see README: sweep ledger)",
     )
-    p.add_argument("ledgers", nargs="+", metavar="LEDGER", help="ledger JSONL path(s)")
+    p.add_argument(
+        "ledgers",
+        nargs="+",
+        metavar="TARGET",
+        help="ledger JSONL path(s), or directories to discover ledgers "
+        "under (e.g. a service --state-dir: all tenant journals render "
+        "grouped by sweep identity)",
+    )
     p.add_argument("--json", action="store_true", help="machine-readable output")
     p.add_argument(
         "--validate",
@@ -256,8 +322,28 @@ def report_main(argv=None) -> int:
     )
     args = p.parse_args(argv)
 
+    # directory targets expand to every discovered ledger underneath;
+    # an empty directory is an operator error surfaced as exit 1 (an
+    # audit that silently checked nothing would read as a green audit)
+    expanded, rc_expand = [], 0
+    for target in args.ledgers:
+        if os.path.isdir(target):
+            hits = discover_ledgers(target)
+            if not hits:
+                # stderr: --json's stdout is a single JSON object "for
+                # machines" and a stray text line would break json.loads
+                print(
+                    f"{target}: no ledgers found under directory",
+                    file=sys.stderr,
+                )
+                rc_expand = 1
+            expanded.extend(hits)
+        else:
+            expanded.append(target)
+    args.ledgers = expanded
+
     if args.validate:
-        rc = 0
+        rc = rc_expand
         out = {}
         for path in args.ledgers:
             problems = validate_ledger(path)
@@ -272,12 +358,12 @@ def report_main(argv=None) -> int:
         return rc
 
     reports = []
-    rc = 0
+    rc = rc_expand
     for path in args.ledgers:
         try:
             reports.append(summarize_ledger(path))
         except (LedgerError, OSError) as e:
-            print(f"{path}: {e}")
+            print(f"{path}: {e}", file=sys.stderr)
             rc = 1
     if args.json:
         overall = None
@@ -289,6 +375,47 @@ def report_main(argv=None) -> int:
     for rep in reports:
         print(_render_text(rep))
     if len(reports) > 1:
+        # the grouped service view: ledgers sharing a sweep identity
+        # (workload + algorithm + space hash) are one logical family —
+        # e.g. N tenants of the same search — and operators compare
+        # within the family before across it
+        groups: dict = {}
+        for r in reports:
+            cfg = r["config"]
+            key = (cfg.get("workload"), cfg.get("algorithm"), cfg.get("space_hash"))
+            groups.setdefault(key, []).append(r)
+        print(f"sweep identities: {len(groups)}")
+        # identity is (workload, algorithm, space_hash) but the label
+        # shows only workload/algorithm — when two groups differ ONLY by
+        # search space (the exact split the grouping exists to make),
+        # a short hash suffix keeps their lines distinguishable
+        pair_counts: dict = {}
+        for w, a, _h in groups:
+            pair_counts[(w, a)] = pair_counts.get((w, a), 0) + 1
+        for (workload, algorithm, h), grp in sorted(
+            groups.items(), key=lambda kv: (str(kv[0][0]), str(kv[0][1]), str(kv[0][2]))
+        ):
+            label = f"{workload}/{algorithm}"
+            if pair_counts[(workload, algorithm)] > 1:
+                label += f" (space {str(h)[:8]})"
+            bests = [r["best"] for r in grp if r["best"] is not None]
+            best_s = (
+                f"best {max(b['score'] for b in bests):.6f}" if bests else "no best"
+            )
+            rates = [r["trials_per_sec"] for r in grp if r["trials_per_sec"]]
+            rate_s = f", {round(sum(rates), 3)} trials/s" if rates else ""
+            states = [
+                r["service"]["state"] for r in grp if r.get("service") is not None
+            ]
+            state_s = (
+                "  [" + " ".join(f"{s}:{states.count(s)}" for s in sorted(set(states))) + "]"
+                if states
+                else ""
+            )
+            print(
+                f"  {label}: {len(grp)} ledger(s), "
+                f"{sum(r['trials'] for r in grp)} trials, {best_s}{rate_s}{state_s}"
+            )
         cands = [
             (r["path"], r["best"]) for r in reports if r["best"] is not None
         ]

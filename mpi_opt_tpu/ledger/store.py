@@ -60,6 +60,25 @@ class LedgerError(ValueError):
     """Malformed or incompatible ledger content."""
 
 
+def sniff_header(path: str) -> Optional[dict]:
+    """Line 1 parsed as a ledger header record, else None — the ONE
+    home for the "is this .jsonl file a ledger?" convention that both
+    ``report``'s directory discovery and ``fsck``'s sibling
+    auto-detection gate on (a metrics stream is also one-JSON-per-line,
+    so the kind check, not the extension, is what identifies a ledger).
+    The first line is capped at 1 MB: a real header is a few hundred
+    bytes, and an arbitrary single-line .jsonl file should cost a
+    bounded read to reject."""
+    try:
+        with open(path, "r") as f:
+            first = json.loads(f.readline(1_000_000))
+    except (OSError, ValueError):
+        return None
+    if isinstance(first, dict) and first.get("kind") == "header":
+        return first
+    return None
+
+
 def _check_shape(rec, lineno: int) -> dict:
     if not isinstance(rec, dict) or "kind" not in rec:
         raise LedgerError(f"line {lineno}: not a ledger record (no 'kind')")

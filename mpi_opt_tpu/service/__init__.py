@@ -1,0 +1,51 @@
+"""Sweep-as-a-service: a resident scheduler multiplexing one device.
+
+The batch CLI's economics are upside down for many small sweeps: every
+invocation pays a full compile+warmup (140–210 s on this device) for
+~2 minutes of search. This package inverts that — ONE long-lived
+server (``mpi_opt_tpu serve``) owns the device and time-slices it
+across submitted sweeps at their natural drain boundaries, so the
+marginal cost of tenant N+1 is program dispatch, not recompilation.
+
+Pieces:
+
+- ``spool``    — filesystem queue + control plane (no network needed)
+- ``tenants``  — the per-job state machine over exit-code outcomes
+- ``programs`` — compiled-program reuse across shape-matching tenants
+- ``scheduler``— the server loop: admit, fair-share pick, slice, park
+- ``client``   — ``submit`` / ``status`` / ``cancel`` / ``drain``
+
+Every mechanism the scheduler leans on already existed for robustness:
+preemption IS the graceful-drain protocol, parking IS exit-75, resume
+IS verified snapshots + ledger journal prefixes. The service adds
+policy, not new failure modes.
+"""
+
+from __future__ import annotations
+
+
+def service_main(argv) -> int:
+    """Dispatch the service subcommands (see cli.main). Lazy imports
+    keep `submit`/`status`/`cancel`/`drain` jax-free and fast."""
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "serve":
+        from mpi_opt_tpu.service.client import serve_main
+
+        return serve_main(rest)
+    if cmd == "submit":
+        from mpi_opt_tpu.service.client import submit_main
+
+        return submit_main(rest)
+    if cmd == "status":
+        from mpi_opt_tpu.service.client import status_main
+
+        return status_main(rest)
+    if cmd == "cancel":
+        from mpi_opt_tpu.service.client import cancel_main
+
+        return cancel_main(rest)
+    if cmd == "drain":
+        from mpi_opt_tpu.service.client import drain_main
+
+        return drain_main(rest)
+    raise ValueError(f"unknown service subcommand {cmd!r}")

@@ -1,0 +1,292 @@
+"""Thin spool clients + the ``serve`` entrypoint (CLI subcommands).
+
+Everything here talks to the service through the filesystem spool —
+``submit``/``status``/``cancel``/``drain`` never import jax and work
+whether or not a server is currently alive (a dead server's spool is
+still a readable queue; jobs submitted to it run when one starts).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from mpi_opt_tpu.service import tenants as tstates
+from mpi_opt_tpu.service.spool import ServerClaimError, Spool, SpoolError
+from mpi_opt_tpu.utils.exitcodes import EX_USAGE
+
+
+def _nonempty_dir(value: str) -> str:
+    # `--state-dir ""` (a classic unset-shell-var slip) would otherwise
+    # build the spool tree relative to the caller's cwd
+    if not value:
+        raise argparse.ArgumentTypeError("must be a non-empty path")
+    return value
+
+
+def _state_dir_parser(prog: str, description: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog=f"mpi_opt_tpu {prog}", description=description)
+    p.add_argument(
+        "--state-dir",
+        required=True,
+        type=_nonempty_dir,
+        metavar="DIR",
+        help="the service spool directory (shared by server and clients)",
+    )
+    return p
+
+
+def serve_main(argv) -> int:
+    p = _state_dir_parser(
+        "serve",
+        "resident multi-tenant sweep server: owns the device, multiplexes "
+        "it across submitted sweeps by time-slicing at natural boundaries",
+    )
+    p.add_argument(
+        "--slice-boundaries",
+        type=int,
+        default=8,
+        metavar="N",
+        help="scheduling quantum: preempt the running tenant after N "
+        "natural boundaries (gen_chunk/rung/TPE-batch/wave/driver-batch); "
+        "the drain flushes a boundary snapshot so the park is free",
+    )
+    p.add_argument(
+        "--slice-seconds",
+        type=float,
+        default=None,
+        metavar="S",
+        help="additional wall-clock quantum: preempt at the FIRST boundary "
+        "past S seconds (whichever of the two budgets trips first)",
+    )
+    p.add_argument(
+        "--max-active-per-tenant",
+        type=int,
+        default=2,
+        metavar="N",
+        help="admission cap: at most N non-terminal jobs per tenant name; "
+        "excess jobs wait in the queue",
+    )
+    p.add_argument(
+        "--poll-seconds", type=float, default=0.5, help="idle spool poll interval"
+    )
+    p.add_argument(
+        "--drain-on-empty",
+        action="store_true",
+        help="exit once the queue is empty and every tenant is terminal "
+        "(batch/drill mode; without it the server stays resident)",
+    )
+    p.add_argument(
+        "--platform",
+        default=None,
+        choices=["cpu", "tpu"],
+        help="pin the jax platform ONCE at server bring-up (tenants may "
+        "not: the server owns the device)",
+    )
+    p.add_argument(
+        "--local-devices",
+        type=int,
+        default=None,
+        help="with --platform cpu: virtual device count for the server",
+    )
+    args = p.parse_args(argv)
+    if args.slice_boundaries < 1:
+        p.error(f"--slice-boundaries must be >= 1, got {args.slice_boundaries}")
+    if args.slice_seconds is not None and args.slice_seconds <= 0:
+        p.error(f"--slice-seconds must be > 0, got {args.slice_seconds}")
+    if args.max_active_per_tenant < 1:
+        p.error(
+            f"--max-active-per-tenant must be >= 1, got {args.max_active_per_tenant}"
+        )
+    # device bring-up happens HERE, once, before any tenant runs, via
+    # the SAME validate-and-pin helper the flat CLI uses (a serve-local
+    # copy once dropped its --local-devices >= 1 guard and turned a
+    # usage error into a deferred backend crash); the persistent
+    # compile cache multiplies across every tenant of the server
+    from mpi_opt_tpu.cli import pin_platform, wire_compile_cache
+
+    wire_compile_cache()
+    pin_platform(args.platform, args.local_devices, p.error)
+    from mpi_opt_tpu.service.scheduler import SweepService
+
+    service = SweepService(
+        args.state_dir,
+        slice_boundaries=args.slice_boundaries,
+        slice_seconds=args.slice_seconds,
+        max_active_per_tenant=args.max_active_per_tenant,
+        poll_seconds=args.poll_seconds,
+        drain_on_empty=args.drain_on_empty,
+        metrics_stream=sys.stdout,
+    )
+    try:
+        return service.serve()
+    except ServerClaimError as e:
+        # ONLY the one-server-per-spool refusal is usage-shaped; any
+        # other exception is a server crash and must keep its traceback
+        print(str(e), file=sys.stderr)
+        return EX_USAGE
+
+
+def submit_main(argv) -> int:
+    p = _state_dir_parser(
+        "submit",
+        "queue a sweep on a service spool; everything after `--` is the "
+        "sweep's own CLI arguments (the flat mpi_opt_tpu surface, minus "
+        "the server-owned flags)",
+    )
+    p.add_argument(
+        "--tenant",
+        default="default",
+        help="tenant name for fair-share scheduling and concurrency caps",
+    )
+    p.add_argument(
+        "sweep_args",
+        nargs=argparse.REMAINDER,
+        metavar="-- ARGS",
+        help="sweep CLI arguments (prefix with `--`)",
+    )
+    args = p.parse_args(argv)
+    sweep = list(args.sweep_args)
+    if sweep and sweep[0] == "--":
+        sweep = sweep[1:]
+    if not sweep:
+        p.error("no sweep arguments given (append `-- --workload ... [flags]`)")
+    spool = Spool(args.state_dir)
+    try:
+        job_id = spool.submit(sweep, tenant=args.tenant)
+    except SpoolError as e:
+        p.error(str(e))
+    print(json.dumps({"job": job_id, "tenant": args.tenant, "state": "queued"}))
+    return 0
+
+
+def _collect_status(spool: Spool) -> dict:
+    server = spool.read_server()
+    jobs = []
+    for qpath in spool.pending_jobs():
+        from mpi_opt_tpu.service.spool import _read_json
+
+        spec = _read_json(qpath) or {}
+        jobs.append(
+            {
+                "job": spec.get("id", os.path.basename(qpath)[:-5]),
+                "tenant": spec.get("tenant", "default"),
+                # same label submit printed and admission will write:
+                # "queued" means "not yet running" on every surface —
+                # a script polling right after submit must not see a
+                # third state the lifecycle diagram doesn't have
+                "state": tstates.QUEUED,
+            }
+        )
+    for t in spool.tenants():
+        s = t.status
+        jobs.append(
+            {
+                "job": t.job_id,
+                "tenant": s.get("tenant", "default"),
+                "state": s.get("state"),
+                "slices": s.get("slices"),
+                "preemptions": s.get("preemptions"),
+                "boundaries": s.get("boundaries"),
+                "best_score": s.get("best_score"),
+                "program_cache": s.get("program_cache"),
+                "first_slice_wall_s": s.get("first_slice_wall_s"),
+            }
+        )
+    return {
+        "state_dir": spool.state_dir,
+        "server": {
+            "alive": spool.server_alive(),
+            **({} if server is None else server),
+        },
+        "draining": spool.drain_requested(),
+        "jobs": jobs,
+    }
+
+
+def status_main(argv) -> int:
+    p = _state_dir_parser("status", "one view of a service spool's jobs")
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    args = p.parse_args(argv)
+    try:
+        spool = Spool(args.state_dir, create=False)
+    except SpoolError as e:
+        p.error(str(e))
+    info = _collect_status(spool)
+    if args.json:
+        print(json.dumps(info))
+        return 0
+    alive = "up" if info["server"]["alive"] else "down"
+    pid = info["server"].get("pid")
+    print(
+        f"service {info['state_dir']}: server {alive}"
+        + (f" (pid {pid})" if pid else "")
+        + (" [draining]" if info["draining"] else "")
+    )
+    if not info["jobs"]:
+        print("  no jobs")
+    for j in info["jobs"]:
+        extra = ""
+        if j.get("slices") is not None:
+            extra = (
+                f"  slices={j['slices']} preemptions={j.get('preemptions')}"
+                f" best={j.get('best_score')}"
+            )
+            pc = j.get("program_cache") or {}
+            if pc.get("hits") or pc.get("misses"):
+                extra += f" cache={pc.get('hits', 0)}h/{pc.get('misses', 0)}m"
+        print(f"  {j['job']}  tenant={j['tenant']}  {j['state']}{extra}")
+    return 0
+
+
+def cancel_main(argv) -> int:
+    p = _state_dir_parser(
+        "cancel",
+        "cancel a job: queued jobs cancel immediately; a running job "
+        "drains at its next natural boundary (snapshot + ledger intact — "
+        "nothing is killed, nothing quarantined) and frees the device",
+    )
+    p.add_argument("job", help="job id (see `mpi_opt_tpu status`)")
+    args = p.parse_args(argv)
+    try:
+        state = Spool(args.state_dir, create=False).cancel(args.job)
+    except SpoolError as e:
+        p.error(str(e))
+    print(json.dumps({"job": args.job, "state": state, "cancel": True}))
+    return 0
+
+
+def drain_main(argv) -> int:
+    p = _state_dir_parser(
+        "drain",
+        "ask the server to stop: it finishes the active slice (parking "
+        "the tenant at a boundary) and exits; the spool keeps the queue, "
+        "so a restarted server continues where this one left off",
+    )
+    p.add_argument(
+        "--wait",
+        type=float,
+        default=None,
+        metavar="S",
+        help="block up to S seconds for the server to exit",
+    )
+    args = p.parse_args(argv)
+    try:
+        spool = Spool(args.state_dir, create=False)
+    except SpoolError as e:
+        p.error(str(e))
+    spool.request_drain()
+    if args.wait is not None:
+        deadline = time.monotonic() + args.wait
+        while spool.server_alive():
+            if time.monotonic() >= deadline:
+                print(
+                    f"server still alive after {args.wait}s", file=sys.stderr
+                )
+                return 1
+            time.sleep(0.2)
+    print(json.dumps({"drain": True, "server_alive": spool.server_alive()}))
+    return 0

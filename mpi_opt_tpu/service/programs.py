@@ -1,0 +1,153 @@
+"""Compiled-program reuse across tenants: the service's warmup killer.
+
+Why a resident server at all: one CLI invocation = one process = one
+cold XLA compile (140–210 s measured per bench round) for minutes of
+useful search. Inside ONE process, jax's jit cache already keys
+compiled programs by (function identity, abstract shapes) — but the
+CLI rebuilds its workload per invocation, and with it the trainer and
+the jitted callables, so identity never matches and nothing is reused.
+
+This layer closes that gap with two moves:
+
+- **shared workload instances**: one instance per registry name for
+  the server's lifetime, injected into ``cli.main(_workload=...)``.
+  The fused drivers cache (trainer, space, arrays) ON the instance
+  (``train.common.workload_arrays``), so a second tenant with a
+  matching (member_chunk, mesh, momentum-dtype) key gets the same
+  trainer object — and a matching population shape then hits the jit
+  cache outright: its marginal cost is the 3–5 ms dispatch floor
+  (PERF_NOTES §2), not compilation.
+- **hit/miss accounting** keyed by (workload, pop-shape, chunking):
+  the scheduler records, per tenant, whether the programs its sweep
+  needs were already compiled in this server, and surfaces the
+  counters in status.json and the server metrics summary — the
+  operator-visible proof that tenant N+1 skipped compile.
+
+A key is a conservative superset of everything that shapes the fused
+programs; matching keys therefore guarantee program reuse, while a
+mismatched key may still partially reuse (same trainer, new shapes).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+
+def _warm_identity(path: Optional[str]):
+    """Key component for --warm-start: fused TPE sizes its compiled obs
+    ring as n_trials + n_warm, where n_warm is the PRIOR ledger's record
+    count — so a warm-starting tenant's programs are not the cold
+    tenant's, and two priors of different length differ again. (path,
+    size, mtime) is the conservative stand-in for n_warm without
+    reading the file: it only ever splits keys, never aliases."""
+    if path is None:
+        return None
+    try:
+        st = os.stat(path)
+        return (path, st.st_size, st.st_mtime_ns)
+    except OSError:
+        return (path, None, None)
+
+
+def program_key(args) -> tuple:
+    """The (workload, pop-shape, chunking) identity of a parsed sweep's
+    compiled programs (args: the CLI parser's namespace)."""
+    return (
+        args.workload,
+        args.backend,
+        bool(args.fused),
+        args.algorithm,
+        # pop-shape: which of these bind depends on the algorithm, but
+        # including the superset only ever splits keys, never aliases
+        args.population,
+        args.trials,
+        args.budget,
+        args.generations,
+        args.steps_per_generation,
+        args.min_budget,
+        args.max_budget,
+        args.eta,
+        # statically baked into the jitted programs: PBTConfig's
+        # truncation_frac sizes the exploit's n_cut at trace time, and
+        # the driver path's eval batches are shaped by worker capacity
+        args.truncation,
+        args.workers,
+        _warm_identity(args.warm_start),
+        # chunking / residency: each changes the compiled program split
+        args.member_chunk,
+        args.gen_chunk,
+        args.step_chunk,
+        str(args.wave_size),
+        # mesh shape: a different device split is a different program
+        bool(args.no_mesh),
+        args.n_data,
+        args.n_pop,
+    )
+
+
+class ProgramCache:
+    def __init__(self):
+        self._workloads: dict = {}
+        self._seen: set = set()
+
+    def acquire(self, argv: list) -> Tuple[Optional[tuple], bool, Optional[object]]:
+        """(key, hit, workload) for one slice's argv.
+
+        ``workload`` is the shared instance to inject into ``cli.main``
+        (None when the argv doesn't parse — the slice will fail as a
+        usage error on its own — or names a --chaos drill, whose
+        wrapper is rebuilt per run by design). ``hit`` is whether this
+        key's programs were already built in this server process; the
+        first slice of a shape is the miss that pays the compile, and
+        every later slice — same tenant resuming, or a shape-matching
+        new tenant — is a hit. A key only enters the seen set via
+        ``commit`` (the scheduler calls it when the slice demonstrably
+        ran: completed or drained at a boundary) — a slice that died
+        BEFORE compiling must not make the next same-shape slice
+        report a warm start that never happened."""
+        import contextlib
+        import io
+
+        from mpi_opt_tpu.cli import build_parser
+
+        # probe parse (micro-cost against a multi-second slice): ALL its
+        # output is suppressed — stderr (usage errors) and stdout too
+        # (`--help` prints multi-KB help, and the server's stdout is its
+        # JSONL metrics stream). The slice's OWN parse of the same argv
+        # re-emits everything inside the tenant's log redirect, so the
+        # text lands in run.log where it's attributable.
+        try:
+            with contextlib.redirect_stdout(io.StringIO()), contextlib.redirect_stderr(
+                io.StringIO()
+            ):
+                args = build_parser().parse_args(list(argv))
+        except SystemExit:
+            return None, False, None
+        if args.chaos is not None:
+            # chaos wrappers are rebuilt per run by design (one tenant's
+            # fault schedule must not leak into another), so a chaos
+            # slice's programs are NEVER warm: no key (a committed
+            # chaos-blind key would falsely warm-start the fault-free
+            # tenant of the same shape), no hit (its own resumed slices
+            # recompile every time), no shared workload
+            return None, False, None
+        key = program_key(args)
+        # hit/miss tallies live with their consumers — per-tenant in
+        # status.json and server-wide in MetricsLogger (the scheduler
+        # records both from this bool); a third copy here would drift
+        hit = key in self._seen
+        workload = self._workloads.get(args.workload)
+        if workload is None:
+            from mpi_opt_tpu.workloads import get_workload
+
+            workload = get_workload(args.workload)
+            self._workloads[args.workload] = workload
+        return key, hit, workload
+
+    def commit(self, key: Optional[tuple]) -> None:
+        """Record that ``key``'s programs were actually built (the
+        slice completed or parked at a boundary — both are past the
+        compile)."""
+        if key is not None:
+            self._seen.add(key)

@@ -1,0 +1,548 @@
+"""The resident multi-tenant sweep server (``mpi_opt_tpu serve``).
+
+One long-lived process owns the JAX device and multiplexes it across
+many concurrent sweeps. The scheduler loop:
+
+1. **admission** — queued job files move into tenant dirs, throttled
+   by a per-tenant concurrency cap (``--max-active-per-tenant``).
+2. **pick** — fair-share over runnable tenants: the tenant NAME that
+   has consumed the fewest slices goes first, FIFO (submit order)
+   within a name. A lone tenant simply keeps getting re-picked.
+3. **slice** — the chosen sweep runs IN-PROCESS via ``cli.main`` with
+   server-owned ``--ledger``/``--checkpoint-dir`` (and ``--resume``
+   after its first slice), under a cooperative slice hook
+   (health/shutdown.py) that counts natural boundaries — gen_chunk /
+   rung / TPE batch / wave / driver batch — and, at the budget, sets
+   the SAME drain flag a platform SIGTERM sets. The sweep flushes a
+   boundary snapshot and exits 75 through the existing drain path, so
+   a time-sliced tenant's ledger is bit-identical to a solo run's.
+4. **classify** — the slice's exit code drives the tenant state
+   machine (tenants.py, codes from utils/exitcodes.py).
+
+Running tenants in-process is what makes admission cheap: workload
+instances (and with them trainers and jit-compiled programs) are
+cached for the server's lifetime (programs.py), so a shape-matching
+tenant skips XLA compilation and its time-to-first-trial is dominated
+by dispatch, not compile.
+
+Shutdown: a real SIGTERM/SIGINT drains the ACTIVE tenant at its next
+boundary (the tenant's own guard handles the signal; the server reads
+``shutdown.delivered_signal()`` after the slice to tell platform
+death from its own slice expiry), parks it, and exits 0 — the spool on
+disk IS the queue checkpoint, so a restarted server resumes every
+in-flight tenant via the verified-snapshot + journal-prefix machinery.
+A SIGKILLed server leaves a tenant marked ``running``; restart demotes
+it to ``parked`` (stale-server detection) and the same resume path
+recovers it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+import traceback
+from typing import Callable, Optional
+
+from mpi_opt_tpu.service import tenants as tstates
+from mpi_opt_tpu.service.programs import ProgramCache
+from mpi_opt_tpu.service.spool import Spool, TenantDir
+
+
+def _read_summary(log_path: str, start: int) -> Optional[dict]:
+    """The last summary-shaped JSON line THIS slice appended to the
+    tenant's run.log (same shape rule as launch.py's supervisor relay).
+
+    ``start`` is the log's size when the slice began: run.log is
+    append-only across the tenant's whole lifetime, and scanning past
+    it would attribute a PREVIOUS slice's summary (and best_score) to
+    a slice that crashed before printing its own."""
+    from mpi_opt_tpu.launch import _find_summary_line
+
+    try:
+        # errors="replace": the seek may land mid-multibyte-character in
+        # some library's non-ASCII log line; summary lines themselves
+        # are pure-ASCII json.dumps output, so replacement never
+        # damages the line we want
+        with open(log_path, errors="replace") as f:
+            f.seek(max(start, os.path.getsize(log_path) - 100_000))
+            line = _find_summary_line(f.read())
+    except OSError:
+        return None
+    return json.loads(line) if line else None
+
+
+class SweepService:
+    def __init__(
+        self,
+        state_dir: str,
+        slice_boundaries: int = 8,
+        slice_seconds: Optional[float] = None,
+        max_active_per_tenant: int = 2,
+        poll_seconds: float = 0.5,
+        drain_on_empty: bool = False,
+        metrics=None,
+        metrics_stream=None,
+        on_boundary: Optional[Callable] = None,
+        on_slice_end: Optional[Callable] = None,
+    ):
+        if slice_boundaries < 1:
+            raise ValueError(f"slice_boundaries must be >= 1, got {slice_boundaries}")
+        if max_active_per_tenant < 1:
+            raise ValueError(
+                f"max_active_per_tenant must be >= 1, got {max_active_per_tenant}"
+            )
+        self.spool = Spool(state_dir)
+        self.slice_boundaries = slice_boundaries
+        self.slice_seconds = slice_seconds
+        self.max_active_per_tenant = max_active_per_tenant
+        self.poll_seconds = poll_seconds
+        self.drain_on_empty = drain_on_empty
+        self.programs = ProgramCache()
+        # test/drill seams: on_boundary(tenant, stage, n) fires from the
+        # slice hook (deterministic injection point for drills that need
+        # "mid-slice" timing); on_slice_end(tenant) after classification
+        self.on_boundary = on_boundary
+        self.on_slice_end = on_slice_end
+        if metrics is None:
+            from mpi_opt_tpu.utils.metrics import MetricsLogger
+
+            metrics = MetricsLogger(path=self.spool.metrics_path, stream=metrics_stream)
+        self.metrics = metrics
+        # terminal tenants never change state again, but they stay in
+        # the spool as the durable record — cache their status so the
+        # loop's cost tracks LIVE tenants, not all-time spool history
+        self._terminal_cache: dict = {}
+        # per-loop-iteration memos: the scheduling steps (_admit_pending,
+        # _apply_queued_cancels, _pick_next, _all_quiet) each scan the
+        # spool, and neither the tenants/ directory listing nor a live
+        # tenant's status.json should be re-read three-plus times per
+        # 0.1 s poll; cleared at the top of every iteration, invalidated
+        # on every scheduler-side write (status) / admission (listing —
+        # clients also materialize tenant dirs via cancel-while-queued,
+        # which the next iteration's fresh listing picks up)
+        self._status_memo: dict = {}
+        self._tenants_memo: Optional[list] = None
+        # queue files are written ONCE (atomic submit) and only ever
+        # removed, so the tenant name — all the admission cap check
+        # needs — is cached by path across iterations: a long queue
+        # waiting behind a capped tenant must not cost one JSON parse
+        # per file per poll tick
+        self._queued_name_cache: dict = {}
+        # fair-share usage is SESSION-scoped: seeded from live (parked/
+        # running) jobs' slice counts so a restart resumes fairness for
+        # in-flight work, but a tenant's long-finished history does not
+        # starve its next job for as many slices as it ever consumed
+        self._usage: dict = {}
+        # jobs already terminal at bring-up never entered the tally, so
+        # pre-mark them retired — _retire_usage must not subtract their
+        # history from a LIVE sibling job's seeded usage
+        self._retired: set = set()
+        for t in self.spool.tenants():
+            s = t.status
+            if s.get("state") in tstates.TERMINAL:
+                self._retired.add(s.get("id") or t.job_id)
+            else:
+                name = s.get("tenant", "default")
+                self._usage[name] = self._usage.get(name, 0) + int(
+                    s.get("slices") or 0
+                )
+
+    # -- scheduling --------------------------------------------------
+
+    def _tenant_status(self, t: TenantDir) -> dict:
+        s = self._terminal_cache.get(t.job_id)
+        if s is not None:
+            return s
+        s = self._status_memo.get(t.job_id)
+        if s is not None:
+            return s
+        s = t.status
+        if s.get("state") in tstates.TERMINAL:
+            self._terminal_cache[t.job_id] = s
+            # a terminal the scheduler didn't produce (client cancelled a
+            # parked job directly) still retires its fair-share usage
+            self._retire_usage(s)
+        else:
+            self._status_memo[t.job_id] = s
+        return s
+
+    def _wrote_status(self, t: TenantDir) -> None:
+        self._status_memo.pop(t.job_id, None)
+
+    def _tenants(self) -> list:
+        if self._tenants_memo is None:
+            self._tenants_memo = self.spool.tenants()
+        return self._tenants_memo
+
+    def _active_counts(self) -> dict:
+        counts: dict = {}
+        for t in self._tenants():
+            s = self._tenant_status(t)
+            if s.get("state") not in tstates.TERMINAL:
+                counts[s.get("tenant", "default")] = (
+                    counts.get(s.get("tenant", "default"), 0) + 1
+                )
+        return counts
+
+    def _admit_pending(self) -> None:
+        """Queue -> tenant dirs, oldest first, honoring the per-tenant
+        concurrency cap (capped jobs stay queued — admission order is
+        re-derived every loop, so a cap freed by one tenant finishing
+        admits the next job with no bookkeeping)."""
+        from mpi_opt_tpu.service.spool import SpoolError, _read_json
+
+        counts = self._active_counts()
+        pending = self.spool.pending_jobs()
+        cache = self._queued_name_cache
+        for stale in set(cache) - set(pending):
+            del cache[stale]  # admitted, cancelled, or quarantined
+        for qpath in pending:
+            name = cache.get(qpath)
+            if name is None:
+                spec = _read_json(qpath) or {}
+                name = spec.get("tenant", "default")
+                cache[qpath] = name
+            if counts.get(name, 0) >= self.max_active_per_tenant:
+                continue
+            try:
+                t = self.spool.admit(qpath)
+            except SpoolError as e:
+                self.metrics.log("tenant_reject", error=str(e))
+                continue
+            counts[name] = counts.get(name, 0) + 1
+            self._tenants_memo = None  # a new tenant dir exists now
+            self.metrics.log("tenant_admit", job=t.job_id, tenant=name)
+
+    def _recover_stale_running(self) -> None:
+        """A tenant stuck in ``running`` with no live server behind it
+        is the SIGKILL shape: demote to parked — its durable state is
+        whatever the last boundary flushed, exactly what --resume's
+        verified-snapshot + journal-prefix machinery expects."""
+        for t in self.spool.tenants():
+            s = t.status
+            if s.get("state") == tstates.RUNNING:
+                t.write_status(
+                    dict(s, state=tstates.PARKED, note="recovered from dead server")
+                )
+                self._wrote_status(t)
+                self.metrics.log("tenant_recovered", job=t.job_id)
+
+    def _apply_queued_cancels(self) -> None:
+        for t in self._tenants():
+            s = self._tenant_status(t)
+            # state first: the memo/terminal-cache lookup is a dict hit,
+            # cancel_requested() is a stat — keep per-iteration syscalls
+            # proportional to LIVE tenants, not all-time spool history
+            if s.get("state") in tstates.RUNNABLE and t.cancel_requested():
+                t.write_status(dict(s, state=tstates.CANCELLED))
+                self._wrote_status(t)
+                self._retire_usage(s)  # a parked job may have slices
+                self.metrics.log("tenant_cancelled", job=t.job_id, at="queue")
+
+    def _retire_usage(self, status: dict) -> None:
+        """Remove a newly-terminal job's slice count from the in-session
+        fair-share tally (every one of its slices was added here +1 at a
+        time, or seeded at restart while the job was still live).
+        Idempotent per job — a client-cancelled parked job reaches this
+        both from _tenant_status's terminal-cache insertion and, for
+        scheduler-produced terminals, from the transition site itself."""
+        job_id = status.get("id")
+        if job_id in self._retired:
+            return
+        self._retired.add(job_id)
+        name = status.get("tenant", "default")
+        self._usage[name] = max(
+            0, self._usage.get(name, 0) - int(status.get("slices") or 0)
+        )
+
+    def _pick_next(self) -> Optional[TenantDir]:
+        """Fair share: fewest-slices tenant name first, FIFO within."""
+        runnable = [
+            (t, s)
+            for t in self._tenants()
+            for s in (self._tenant_status(t),)
+            if s.get("state") in tstates.RUNNABLE
+        ]
+        if not runnable:
+            return None
+        runnable.sort(
+            key=lambda ts: (
+                self._usage.get(ts[1].get("tenant", "default"), 0),
+                ts[0].job_id,
+            )
+        )
+        return runnable[0][0]
+
+    # -- the slice ---------------------------------------------------
+
+    def _slice_argv(self, t: TenantDir, status: dict) -> list:
+        # --resume UNCONDITIONALLY: empty ledger/checkpoint dirs start
+        # fresh under it, and a server killed mid-FIRST-slice leaves
+        # slices=0 with durable state already on disk — a fresh (non
+        # -resume) retry would trip the CLI's stale-state refusal
+        # (exit 2) and terminally fail a perfectly recoverable tenant
+        return list(t.job["argv"]) + [
+            "--ledger",
+            t.ledger,
+            "--checkpoint-dir",
+            t.ckpt,
+            "--resume",
+        ]
+
+    def _run_slice(self, t: TenantDir) -> Optional[str]:
+        """One scheduling quantum on the device. Returns the REAL signal
+        name if one was delivered mid-slice (the server must drain), else
+        None."""
+        from mpi_opt_tpu.cli import main as cli_main
+        from mpi_opt_tpu.health import shutdown
+        from mpi_opt_tpu.service.spool import SpoolError
+
+        # a real signal may land between the serve loop's shutdown check
+        # and here (spool scans, the argparse probe): the SERVER guard
+        # absorbed it, and the clear_delivered() below would erase the
+        # evidence — so the tenant would burn a full quantum before the
+        # drain. Re-check now, before any tenant state changes.
+        if shutdown.requested() or shutdown.delivered_signal():
+            return shutdown.delivered_signal() or shutdown.active_signal()
+
+        status = t.status
+        try:
+            argv = self._slice_argv(t, status)
+        except SpoolError as e:
+            # one tenant's unreadable job.json must not take down the
+            # server (and every other tenant with it): terminal-fail
+            # just this tenant and keep scheduling
+            t.write_status(dict(status, state=tstates.FAILED, note=str(e)))
+            self._wrote_status(t)
+            self._retire_usage(status)
+            self.metrics.log("tenant_reject", job=t.job_id, error=str(e))
+            return None
+        try:
+            # acquire builds the shared workload instance on first use
+            # (get_workload -> cls(): dataset caches, disk, arbitrary
+            # user code) and the log open touches the tenant's own dir —
+            # either failing must terminal-fail THIS tenant, same as the
+            # unreadable-job.json case above: the tenant is still
+            # RUNNABLE at this point, so letting the exception out would
+            # crash-loop every restarted server on the same pick
+            key, cache_hit, workload = self.programs.acquire(argv)
+            log_start = os.path.getsize(t.log) if os.path.exists(t.log) else 0
+            logf = open(t.log, "a")
+        except Exception as e:
+            t.write_status(
+                dict(status, state=tstates.FAILED, note=f"slice setup failed: {e}")
+            )
+            self._wrote_status(t)
+            self._retire_usage(status)
+            self.metrics.log("tenant_reject", job=t.job_id, error=str(e))
+            return None
+        t.write_status(dict(status, state=tstates.RUNNING))
+        self._wrote_status(t)
+        self.metrics.log(
+            "slice_start",
+            job=t.job_id,
+            tenant=status.get("tenant", "default"),
+            slice=int(status.get("slices") or 0) + 1,
+            program_cache_hit=cache_hit,
+        )
+        boundaries = 0
+        t0 = time.perf_counter()
+
+        def hook(stage: str) -> None:
+            nonlocal boundaries
+            boundaries += 1
+            if self.on_boundary is not None:
+                self.on_boundary(t, stage, boundaries)
+            # delivered_signal: a real signal that landed in the sliver
+            # between the pre-slice check and the tenant guard's install
+            # went to the SERVER guard, which the tenant's own handler
+            # can't see — treat it like drain so the park still happens
+            # at the FIRST boundary, not after a full quantum
+            if (
+                t.cancel_requested()
+                or self.spool.drain_requested()
+                or shutdown.delivered_signal()
+            ):
+                shutdown.request()
+                return
+            if boundaries >= self.slice_boundaries or (
+                self.slice_seconds is not None
+                and time.perf_counter() - t0 >= self.slice_seconds
+            ):
+                shutdown.request()
+
+        # NO clear_delivered() here: the serve loop clears the window at
+        # bring-up and breaks on any truthy delivery, so _DELIVERED is
+        # None when a slice starts — a truthy value at any point from
+        # here on IS this slice's signal, and erasing it would burn a
+        # full quantum before the server notices (the hook above and the
+        # post-slice read both depend on it surviving)
+        shutdown.set_slice_hook(hook)
+        try:
+            with logf:
+                logf.write(f"--- slice {int(status.get('slices') or 0) + 1} ---\n")
+                with contextlib.redirect_stdout(logf), contextlib.redirect_stderr(
+                    logf
+                ):
+                    try:
+                        rc = cli_main(argv, _workload=workload)
+                    except SystemExit as e:
+                        # parser.error and friends (in-process argparse).
+                        # Match what the same argv would do as a
+                        # subprocess: None exits 0, a string message
+                        # prints and exits 1 — and the message must land
+                        # in run.log (we ARE its stderr right now), not
+                        # vanish with the exception
+                        if e.code is None:
+                            rc = 0
+                        elif isinstance(e.code, int):
+                            rc = e.code
+                        else:
+                            logf.write(f"{e.code}\n")
+                            rc = 1
+                    except KeyboardInterrupt:
+                        raise
+                    except BaseException:
+                        logf.write(traceback.format_exc())
+                        rc = 1
+        finally:
+            shutdown.clear_slice_hook()
+        wall = time.perf_counter() - t0
+        delivered = shutdown.delivered_signal()
+
+        cancel = t.cancel_requested()
+        state = tstates.after_slice(rc, cancel)
+        if state in (tstates.DONE, tstates.PARKED, tstates.CANCELLED):
+            # the sweep completed or drained at a boundary — both are
+            # past compile, so the key's programs really exist now
+            self.programs.commit(key)
+        status = t.status  # re-read: cancel client may have raced a write
+        status["state"] = state
+        status["slices"] = int(status.get("slices") or 0) + 1
+        status["boundaries"] = int(status.get("boundaries") or 0) + boundaries
+        # capped tail: state classification uses rc directly and the
+        # full per-slice record lives in the metrics stream — an
+        # unbounded array would make every slice end rewrite (and every
+        # status call re-parse) O(total slices) on a long-lived server
+        status["rc_history"] = ((status.get("rc_history") or []) + [rc])[-32:]
+        if state == tstates.PARKED and not delivered:
+            status["preemptions"] = int(status.get("preemptions") or 0) + 1
+        pc = status.setdefault("program_cache", {"hits": 0, "misses": 0})
+        pc["hits" if cache_hit else "misses"] += 1
+        if status.get("first_slice_wall_s") is None:
+            # time-to-first-trial proxy: the first slice carries all of
+            # the tenant's setup (compile on a miss, dispatch on a hit)
+            status["first_slice_wall_s"] = round(wall, 3)
+            status["first_slice_program_cache_hit"] = cache_hit
+        summary = _read_summary(t.log, log_start)
+        if summary is not None:
+            status["summary"] = summary
+            if summary.get("best_score") is not None:
+                status["best_score"] = summary["best_score"]
+        t.write_status(status)
+        self._wrote_status(t)
+        name = status.get("tenant", "default")
+        self._usage[name] = self._usage.get(name, 0) + 1
+        if state in tstates.TERMINAL:
+            # retire the finished job's whole slice history from the
+            # fair-share ledger: usage is meant to balance LIVE work,
+            # and on a long-lived server a tenant whose 50-slice job
+            # just completed must not have its NEXT submission starved
+            # for 50 slices (the restart seeding skips terminal jobs
+            # for the same reason)
+            self._retire_usage(status)
+        self.metrics.count_slices()
+        if cache_hit:
+            self.metrics.count_program_cache(hits=1)
+        else:
+            self.metrics.count_program_cache(misses=1)
+        if state == tstates.DONE:
+            self.metrics.count_tenants_done()
+        self.metrics.log(
+            "slice_end",
+            job=t.job_id,
+            rc=rc,
+            state=state,
+            boundaries=boundaries,
+            wall_s=round(wall, 3),
+            signal=delivered,
+        )
+        if self.on_slice_end is not None:
+            self.on_slice_end(t)
+        return delivered
+
+    # -- the loop ----------------------------------------------------
+
+    def _all_quiet(self) -> bool:
+        if self.spool.pending_jobs():
+            return False
+        return all(
+            self._tenant_status(t).get("state") in tstates.TERMINAL
+            for t in self._tenants()
+        )
+
+    def serve(self) -> int:
+        from mpi_opt_tpu.health import shutdown
+
+        try:
+            # absl's stderr handler binds sys.stderr AT FIRST IMPORT; if
+            # that first import happened inside a slice (orbax pulls it
+            # in), it would latch the tenant's redirected log file and
+            # spew "Logging error" noise once that file closes. Import
+            # it now, while stderr is the server's real stream.
+            import absl.logging  # noqa: F401
+        except ImportError:
+            pass
+        if not self.spool.claim_server(slice_boundaries=self.slice_boundaries):
+            from mpi_opt_tpu.service.spool import ServerClaimError
+
+            info = self.spool.read_server() or {}
+            raise ServerClaimError(
+                f"a server (pid {info.get('pid')}) already owns "
+                f"{self.spool.state_dir}; one device, one server"
+            )
+        self.spool.clear_drain()
+        # open THIS server's signal-observation window: a signal a
+        # previous in-process server (or sweep) absorbed is not ours
+        shutdown.clear_delivered()
+        self._recover_stale_running()
+        self.metrics.log(
+            "serve_start",
+            state_dir=self.spool.state_dir,
+            slice_boundaries=self.slice_boundaries,
+            max_active_per_tenant=self.max_active_per_tenant,
+        )
+        reason = "drain"
+        try:
+            with shutdown.ShutdownGuard() as guard:
+                while True:
+                    self._status_memo.clear()
+                    self._tenants_memo = None
+                    self._admit_pending()
+                    self._apply_queued_cancels()
+                    if guard.requested or shutdown.delivered_signal():
+                        reason = f"signal {guard.signal_name or shutdown.delivered_signal()}"
+                        break
+                    if self.spool.drain_requested():
+                        break
+                    t = self._pick_next()
+                    if t is None:
+                        if self.drain_on_empty and self._all_quiet():
+                            reason = "empty"
+                            break
+                        time.sleep(self.poll_seconds)
+                        continue
+                    delivered = self._run_slice(t)
+                    if delivered:
+                        # the platform told the PROCESS to die; the
+                        # active tenant already drained + parked through
+                        # its own guard — park the server too
+                        reason = f"signal {delivered}"
+                        break
+        finally:
+            self.spool.clear_server()
+            self.metrics.summary(final=True, reason=reason)
+            self.metrics.close()
+        return 0

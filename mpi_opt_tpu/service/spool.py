@@ -1,0 +1,420 @@
+"""Filesystem job spool: the service's durable queue + control plane.
+
+No network dependency (this container has none to offer): clients and
+server rendezvous on a shared ``--state-dir``. Every write is atomic
+(tmp + rename, the heartbeat pattern), every decision the scheduler
+makes is re-derivable from the files — so the spool IS the queue
+checkpoint: a SIGKILLed server restarts, reads the tree, and continues
+where it left off with no separate recovery file.
+
+Layout::
+
+    state-dir/
+      server.json           # the live server's pid + heartbeat (liveness)
+      server-metrics.jsonl  # the server's own JSONL metrics stream
+      control/drain         # flag: finish the active slice, park, exit
+      queue/<job>.json      # submitted jobs not yet admitted
+      tenants/<job>/
+        job.json            # the submitted spec (argv, tenant, ts)
+        status.json         # tenant state machine record (tenants.py)
+        cancel              # flag: cancel this job at its next boundary
+        ledger.jsonl        # per-tenant durable trial journal
+        ckpt/               # per-tenant snapshot root
+        run.log             # captured stdout/stderr of every slice
+
+Job ids are zero-padded submit-nanosecond stamps, so lexicographic
+order IS submission order (the FIFO tiebreak needs no extra index).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+#: sweep flags the server owns per tenant; a submitted job naming one
+#: would fight the server over the tenant's durable-state layout (or,
+#: for the SPMD flags, over the device itself)
+RESERVED_FLAGS = (
+    "--ledger",
+    "--checkpoint-dir",
+    "--resume",
+    "--metrics-file",
+    "--heartbeat-file",
+    "--coordinator",
+    "--num-processes",
+    "--process-id",
+    "--multihost",
+    # the server owns the device: platform pinning happens ONCE at
+    # `serve` bring-up, not per tenant (a mid-process re-pin would
+    # either fail or fight the resident programs)
+    "--platform",
+    "--local-devices",
+)
+
+
+class SpoolError(ValueError):
+    """Malformed spool content or an invalid client request."""
+
+
+class ServerClaimError(RuntimeError):
+    """Another live server already owns this spool (one device, one
+    server). The ONE serve failure that is usage-shaped: the operator
+    pointed a second server at a claimed state-dir."""
+
+
+def _write_json_atomic(path: str, obj: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _pid_start(pid: int) -> Optional[str]:
+    """The kernel's start-time identity for a pid (Linux /proc; None
+    where unavailable). pid + starttime is collision-proof against pid
+    reuse; a bare pid is not — the kernel recycles them."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            stat = f.read()
+        # comm (field 2) may itself contain spaces and parens: the
+        # numeric fields resume after the LAST ')', where state is
+        # field 3 — starttime is field 22, i.e. index 19 from there
+        return stat.rsplit(")", 1)[1].split()[19]
+    except (OSError, IndexError):
+        return None
+
+
+def check_argv(argv: list) -> None:
+    """Client-side admission gate: refuse reserved / server-owned flags
+    at submit time, where the error is cheap and attributable."""
+    for a in argv:
+        flag = a.split("=", 1)[0]
+        if not flag.startswith("--"):
+            continue
+        # prefix match, not equality: argparse resolves unambiguous
+        # abbreviations (allow_abbrev), so `--platfor` would reach the
+        # slice's parser as --platform and bypass an exact-string gate
+        for reserved in RESERVED_FLAGS:
+            if len(flag) > 2 and reserved.startswith(flag):
+                raise SpoolError(
+                    f"{flag} is (or abbreviates) server-owned {reserved} "
+                    "(the service assigns each tenant its own "
+                    "ledger/checkpoint root and owns the device "
+                    "bring-up); submit the sweep without it"
+                )
+
+
+class TenantDir:
+    """One tenant's slice of the spool: paths + status accessors."""
+
+    def __init__(self, root: str, job_id: str):
+        self.job_id = job_id
+        self.dir = os.path.join(root, job_id)
+        self.job_path = os.path.join(self.dir, "job.json")
+        self.status_path = os.path.join(self.dir, "status.json")
+        self.cancel_path = os.path.join(self.dir, "cancel")
+        self.ledger = os.path.join(self.dir, "ledger.jsonl")
+        self.ckpt = os.path.join(self.dir, "ckpt")
+        self.log = os.path.join(self.dir, "run.log")
+
+    @property
+    def job(self) -> dict:
+        job = _read_json(self.job_path)
+        if job is None:
+            raise SpoolError(f"{self.job_path}: unreadable job spec")
+        return job
+
+    @property
+    def status(self) -> dict:
+        return _read_json(self.status_path) or {}
+
+    def write_status(self, status: dict) -> None:
+        status = dict(status, updated_ts=round(time.time(), 4))
+        _write_json_atomic(self.status_path, status)
+
+    def cancel_requested(self) -> bool:
+        return os.path.exists(self.cancel_path)
+
+    def request_cancel(self) -> None:
+        with open(self.cancel_path, "w") as f:
+            f.write("")
+
+
+class Spool:
+    def __init__(self, state_dir: str, create: bool = True):
+        """``create=False`` is the read-only clients' mode (status /
+        cancel / drain): they must refuse a path that is not already a
+        spool — silently fabricating an empty tree at a mistyped
+        ``--state-dir`` would answer "server down, no jobs" about a
+        spool that does not exist (and drop drain flags no server
+        watches). ``serve`` and ``submit`` create: submitting to a
+        not-yet-started spool is the documented queue-ahead shape."""
+        self.state_dir = state_dir
+        self.queue_dir = os.path.join(state_dir, "queue")
+        self.tenants_dir = os.path.join(state_dir, "tenants")
+        self.control_dir = os.path.join(state_dir, "control")
+        self.server_path = os.path.join(state_dir, "server.json")
+        self.metrics_path = os.path.join(state_dir, "server-metrics.jsonl")
+        self._drain_path = os.path.join(self.control_dir, "drain")
+        if create:
+            for d in (self.queue_dir, self.tenants_dir, self.control_dir):
+                os.makedirs(d, exist_ok=True)
+        elif not os.path.isdir(self.queue_dir):
+            raise SpoolError(
+                f"{state_dir}: not a service spool (no queue/ underneath) "
+                "— mistyped --state-dir?"
+            )
+
+    # -- client side -------------------------------------------------
+
+    def submit(self, argv: list, tenant: str = "default") -> str:
+        """Drop a job file in the queue; returns the job id. The id's
+        nanosecond stamp makes collisions impossible within a process
+        and sorts by submission time across processes."""
+        check_argv(argv)
+        job_id = f"job-{time.time_ns():020d}-{os.getpid() % 100000:05d}"
+        spec = {
+            "id": job_id,
+            "tenant": tenant,
+            "argv": list(argv),
+            "submitted_ts": round(time.time(), 4),
+        }
+        _write_json_atomic(os.path.join(self.queue_dir, f"{job_id}.json"), spec)
+        return job_id
+
+    def cancel(self, job_id: str) -> str:
+        """Cancel a job wherever it lives. Queued jobs cancel
+        immediately (they never ran: the queue file becomes a terminal
+        tenant record); admitted jobs get a cancel flag the server
+        honors at the tenant's next boundary — nothing is killed, so
+        nothing needs quarantine. Returns the resulting state."""
+        from mpi_opt_tpu.service import tenants as tstates
+
+        qpath = os.path.join(self.queue_dir, f"{job_id}.json")
+        if os.path.exists(qpath):
+            try:
+                t = self._materialize(qpath)
+            except SpoolError:
+                # lost the claim race to the server's admission — the
+                # tenant dir exists now; fall through and cancel it there
+                t = None
+            if t is not None:
+                # flag FIRST: if the server's racing QUEUED status write
+                # lands after our CANCELLED one, the flag still cancels
+                # the tenant at admission or its first boundary
+                t.request_cancel()
+                t.write_status(
+                    dict(
+                        t.status,
+                        state=tstates.CANCELLED,
+                        note="cancelled while queued",
+                    )
+                )
+                return tstates.CANCELLED
+        t = self.tenant(job_id)
+        if t is None:
+            raise SpoolError(f"unknown job {job_id!r}")
+        state = t.status.get("state")
+        if state in tstates.TERMINAL:
+            return state
+        if state in (tstates.QUEUED, tstates.PARKED):
+            # not on the device: terminal immediately — but raise the
+            # flag FIRST, so a server that picked this tenant between
+            # our state read and the status write still drains it at
+            # the next boundary instead of silently overwriting the
+            # CANCELLED record at slice end
+            t.request_cancel()
+            t.write_status(dict(t.status, state=tstates.CANCELLED))
+            return tstates.CANCELLED
+        t.request_cancel()
+        return state or tstates.QUEUED
+
+    def request_drain(self) -> None:
+        with open(self._drain_path, "w") as f:
+            f.write("")
+
+    def drain_requested(self) -> bool:
+        return os.path.exists(self._drain_path)
+
+    def clear_drain(self) -> None:
+        try:
+            os.unlink(self._drain_path)
+        except FileNotFoundError:
+            pass
+
+    # -- server side -------------------------------------------------
+
+    def pending_jobs(self) -> list:
+        """Queue files in submission (= lexicographic) order."""
+        return sorted(
+            os.path.join(self.queue_dir, f)
+            for f in os.listdir(self.queue_dir)
+            if f.endswith(".json")
+        )
+
+    def _materialize(self, queue_path: str) -> TenantDir:
+        """Move a queue file into a tenant dir (the admission step's
+        mechanical half; scheduler.py decides WHEN)."""
+        from mpi_opt_tpu.service import tenants as tstates
+
+        spec = _read_json(queue_path)
+        if spec is None or "id" not in spec or "argv" not in spec:
+            if not os.path.exists(queue_path):
+                # lost a race: the other side of a concurrent
+                # cancel-while-queued / admission already took it
+                raise SpoolError(f"{queue_path}: already claimed by a peer")
+            # a torn/garbage submit: park it out of the queue loudly
+            bad = queue_path + ".malformed"
+            try:
+                os.replace(queue_path, bad)
+            except FileNotFoundError:
+                raise SpoolError(f"{queue_path}: already claimed by a peer")
+            raise SpoolError(f"malformed job file moved to {bad}")
+        t = TenantDir(self.tenants_dir, spec["id"])
+        os.makedirs(t.dir, exist_ok=True)
+        _write_json_atomic(t.job_path, spec)
+        t.write_status(
+            {
+                "id": spec["id"],
+                "tenant": spec.get("tenant", "default"),
+                "state": tstates.QUEUED,
+                "slices": 0,
+                "preemptions": 0,
+                "boundaries": 0,
+                "rc_history": [],
+                "program_cache": {"hits": 0, "misses": 0},
+                "submitted_ts": spec.get("submitted_ts"),
+            }
+        )
+        try:
+            os.unlink(queue_path)
+        except FileNotFoundError:
+            pass  # a racing peer already removed it; the tenant dir wins
+        return t
+
+    def admit(self, queue_path: str) -> TenantDir:
+        return self._materialize(queue_path)
+
+    def tenant(self, job_id: str) -> Optional[TenantDir]:
+        t = TenantDir(self.tenants_dir, job_id)
+        return t if os.path.isdir(t.dir) else None
+
+    def tenants(self) -> list:
+        """All admitted tenants, submission-ordered."""
+        return [
+            TenantDir(self.tenants_dir, d)
+            for d in sorted(os.listdir(self.tenants_dir))
+            if os.path.isdir(os.path.join(self.tenants_dir, d))
+        ]
+
+    # -- server liveness ---------------------------------------------
+
+    def read_server(self) -> Optional[dict]:
+        return _read_json(self.server_path)
+
+    def server_alive(self) -> bool:
+        return self._pid_alive(self.read_server())
+
+    def _claim_fields(self, **fields) -> dict:
+        return {
+            "pid": os.getpid(),
+            "pid_start": _pid_start(os.getpid()),
+            "ts": round(time.time(), 4),
+            **fields,
+        }
+
+    def write_server(self, **fields) -> None:
+        _write_json_atomic(self.server_path, self._claim_fields(**fields))
+
+    def _pid_alive(self, info: Optional[dict]) -> bool:
+        if not info or "pid" not in info:
+            return False
+        try:
+            pid = int(info["pid"])
+            os.kill(pid, 0)
+        except PermissionError:
+            # EPERM is a LIVE process owned by someone else — on a
+            # shared state-dir the one-server-per-spool refusal must
+            # still see it (and /proc/<pid>/stat below stays readable)
+            pass
+        except (OSError, ValueError):
+            return False
+        # the pid exists — but is it the SAME process? A SIGKILLed
+        # server never clears its claim, and the kernel eventually
+        # recycles its pid for an unrelated process, which would hold
+        # the spool hostage until an operator deleted server.json by
+        # hand. The recorded start time settles it; claims without one
+        # (older files, non-Linux hosts) keep the bare-pid behavior.
+        recorded = info.get("pid_start")
+        if recorded is not None:
+            current = _pid_start(pid)
+            if current is not None and current != recorded:
+                return False
+        return True
+
+    def claim_server(self, **fields) -> bool:
+        """Atomically claim the spool for THIS process (O_EXCL create of
+        server.json — a check-then-write would let two servers racing
+        through the same window both believe they own the device).
+
+        A claim held by a dead pid (SIGKILLed server) is broken via
+        rename-takeover: rename wins for exactly ONE claimant, and the
+        renamed file is inspected AFTER the steal — if it turns out to
+        be a peer's fresh LIVE claim (the peer broke the stale one and
+        re-claimed between our read and our rename), it is restored and
+        we lose. Returns False when a live server holds the spool."""
+        for _ in range(8):  # bounded: every retry means the file changed
+            try:
+                fd = os.open(
+                    self.server_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY
+                )
+            except FileExistsError:
+                if self.server_alive():
+                    return False
+                tomb = f"{self.server_path}.stale.{os.getpid()}"
+                try:
+                    os.rename(self.server_path, tomb)
+                except FileNotFoundError:
+                    continue  # another claimant removed it; retry O_EXCL
+                stolen = _read_json(tomb)
+                try:
+                    os.unlink(tomb)
+                except FileNotFoundError:
+                    pass
+                if self._pid_alive(stolen):
+                    # we stole a live claim — put it back and concede
+                    try:
+                        restore = os.open(
+                            self.server_path,
+                            os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                        )
+                    except FileExistsError:
+                        return False
+                    with os.fdopen(restore, "w") as f:
+                        json.dump(stolen, f)
+                    return False
+                continue  # the claim really was dead; retry O_EXCL
+            with os.fdopen(fd, "w") as f:
+                json.dump(self._claim_fields(**fields), f)
+                f.flush()
+                os.fsync(f.fileno())
+            return True
+        return False
+
+    def clear_server(self) -> None:
+        try:
+            os.unlink(self.server_path)
+        except FileNotFoundError:
+            pass

@@ -90,10 +90,21 @@ def launch_boundary(stage: str, *, final: bool, snapshot=None, **progress) -> No
     so the CLI exits EX_TEMPFAIL and the launch supervisor restarts with
     ``--resume`` for free. ``final=True`` (the sweep's last boundary)
     suppresses the drain: completing normally strictly dominates
-    preempting a finished sweep."""
+    preempting a finished sweep.
+
+    This is also the cooperative-slice point for the resident sweep
+    service (service/scheduler.py): an installed slice hook
+    (``shutdown.set_slice_hook``) gets its per-boundary look FIRST and
+    may set the very drain flag checked next — so a time-sliced tenant
+    parks through the identical flush-snapshot-and-raise path a
+    platform SIGTERM takes, and its ledger/snapshot state cannot
+    differ from a preempted run's.
+    """
     from mpi_opt_tpu.health import heartbeat, shutdown
 
     heartbeat.beat(stage=stage, **progress)
+    if not final:
+        shutdown.poll_slice(stage)
     if final or not shutdown.requested():
         return
     if snapshot is not None:

@@ -1,0 +1,54 @@
+"""The sweep processes' exit-code contract, in ONE place.
+
+Three layers classify these codes — the CLI (producing them), the
+launch supervisor (restart policy), and the service's tenant state
+machine (scheduling) — which is two places too many to keep literal
+75s and 65s in sync by hand. Everything that maps an exit code to a
+recovery decision imports from here.
+
+The codes (sysexits.h where one exists):
+
+- ``EX_OK`` (0): the sweep completed; the summary JSON line is final.
+- ``EX_FAILURE`` (1): a RETRYABLE failure — a crashed rank, an aborted
+  sweep (circuit breaker), an unclassified exception. Supervisors may
+  bill a retry and relaunch.
+- ``EX_USAGE`` (2): argparse's usage-error code. The invocation itself
+  is wrong; no retry can help and a supervisor "recovering" it would
+  loop forever on the same refusal.
+- ``EX_DATAERR`` (65): durable state is poisoned (no verified snapshot
+  remains, a journal diverges from the sweep it claims to record). The
+  one failure class a supervisor must NOT retry: a restart re-reads the
+  same poisoned state. Abort with diagnostics.
+- ``EX_TEMPFAIL`` (75): the graceful-shutdown protocol's code — the
+  sweep drained at a boundary with durable state flushed. "Restart me
+  with ``--resume``, and don't bill the retry budget." The service's
+  time-slice preemption exits through the same drain path, so 75 is
+  also the code a parked tenant leaves behind.
+"""
+
+from __future__ import annotations
+
+EX_OK = 0
+EX_FAILURE = 1
+EX_USAGE = 2
+# sysexits.h EX_DATAERR: "input data was incorrect in some way"
+EX_DATAERR = 65
+# sysexits.h EX_TEMPFAIL: "temporary failure, user is invited to retry"
+EX_TEMPFAIL = 75
+
+_OUTCOMES = {
+    EX_OK: "ok",
+    EX_USAGE: "usage",
+    EX_DATAERR: "data_error",
+    EX_TEMPFAIL: "preempted",
+}
+
+
+def classify(rc: int) -> str:
+    """Exit code -> outcome class: ``ok`` / ``usage`` / ``data_error``
+    / ``preempted`` / ``failure`` (the catch-all for every other
+    nonzero code, including 1). ``preempted`` is the only outcome that
+    means "resumable, for free"; ``usage`` and ``data_error`` are
+    terminal-without-retry; ``failure`` is terminal-or-retry at the
+    caller's budget."""
+    return _OUTCOMES.get(int(rc), "failure")

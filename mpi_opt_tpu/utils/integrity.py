@@ -52,11 +52,12 @@ import json
 import os
 from typing import Callable, Optional
 
-# sysexits.h EX_DATAERR: "input data was incorrect in some way". The
-# exit code for "resume found snapshots but none verified" — the one
-# failure a launch supervisor must classify as NON-retryable (a restart
-# re-reads the same poisoned state; see launch.py).
-EX_DATAERR = 65
+# EX_DATAERR re-export (utils/exitcodes.py is the one home for the
+# values; the historical `utils.integrity.EX_DATAERR` surface stays):
+# the exit code for "resume found snapshots but none verified" — the
+# one failure a launch supervisor must classify as NON-retryable (a
+# restart re-reads the same poisoned state; see launch.py).
+from mpi_opt_tpu.utils.exitcodes import EX_DATAERR  # noqa: F401
 
 MANIFEST_ITEM = "manifest"
 MANIFEST_VERSION = 1
@@ -491,28 +492,20 @@ def load_search_state(root: str, step: int, mgr=None) -> Optional[dict]:
 
 
 def _sniffs_as_ledger(path: str) -> bool:
-    """Does line 1 look like a ledger header? (fsck's auto-detect gate)"""
-    try:
-        with open(path, "r") as f:
-            first = json.loads(f.readline())
-        return isinstance(first, dict) and first.get("kind") == "header"
-    except (OSError, json.JSONDecodeError):
-        return False
+    """Does line 1 look like a ledger header? (fsck's auto-detect gate;
+    the sniff itself has one home, ``ledger.store.sniff_header``)"""
+    from mpi_opt_tpu.ledger.store import sniff_header
+
+    return sniff_header(path) is not None
 
 
 def _sniffs_as_fused_ledger(path: str) -> bool:
     """Was this ledger written by a fused sweep? (picks which replay
     cross-check fsck runs: boundary-granular vs trial-granular)"""
-    try:
-        with open(path, "r") as f:
-            first = json.loads(f.readline())
-        return (
-            isinstance(first, dict)
-            and first.get("kind") == "header"
-            and first.get("config", {}).get("mode") == "fused"
-        )
-    except (OSError, json.JSONDecodeError):
-        return False
+    from mpi_opt_tpu.ledger.store import sniff_header
+
+    header = sniff_header(path)
+    return header is not None and header.get("config", {}).get("mode") == "fused"
 
 
 def fsck_main(argv=None) -> int:

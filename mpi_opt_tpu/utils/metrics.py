@@ -57,6 +57,16 @@ class MetricsLogger:
         # re-computations on resume deliberately excluded — they are the
         # fused twin of `replayed`, carried in the summary's journal dict)
         self.members_journaled = 0
+        # service-layer counters (service/scheduler.py, the resident
+        # multi-tenant server): slices is scheduling quanta executed;
+        # program_cache_hits/misses is the compiled-program reuse layer's
+        # accounting — hits are slices whose (workload, pop-shape,
+        # chunking) programs were already compiled in this process, the
+        # observable form of "tenant N+1's cost is dispatch, not compile"
+        self.slices = 0
+        self.tenants_done = 0
+        self.program_cache_hits = 0
+        self.program_cache_misses = 0
 
     def log(self, event: str, **fields) -> dict:
         # `t` is relative (this process's clock, for intra-run deltas);
@@ -119,6 +129,19 @@ class MetricsLogger:
         """Fused member records appended to the sweep ledger."""
         self.members_journaled += int(n)
 
+    def count_slices(self, n: int = 1):
+        """Service scheduling quanta (tenant slices) executed."""
+        self.slices += int(n)
+
+    def count_tenants_done(self, n: int = 1):
+        """Service tenants that reached the done state."""
+        self.tenants_done += int(n)
+
+    def count_program_cache(self, hits: int = 0, misses: int = 0):
+        """Compiled-program reuse accounting (service/programs.py)."""
+        self.program_cache_hits += int(hits)
+        self.program_cache_misses += int(misses)
+
     @property
     def wall(self) -> float:
         return time.perf_counter() - self.t_start
@@ -141,6 +164,10 @@ class MetricsLogger:
             staged_bytes=self.staged_bytes,
             stage_overlap_s=round(self.stage_overlap_s, 3),
             members_journaled=self.members_journaled,
+            slices=self.slices,
+            tenants_done=self.tenants_done,
+            program_cache_hits=self.program_cache_hits,
+            program_cache_misses=self.program_cache_misses,
             wall_s=round(self.wall, 3),
             trials_per_sec_per_chip=round(self.trials_per_sec_per_chip(), 4),
             **extra,

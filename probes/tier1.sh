@@ -12,6 +12,7 @@
 #        T1_LOG=/tmp/my.log probes/tier1.sh   # custom log path
 #        T1_SKIP_FSCK_DRILL=1 probes/tier1.sh # skip the fsck drill
 #        T1_SKIP_FUSED_LEDGER_DRILL=1 probes/tier1.sh # skip the ledger drill
+#        T1_SKIP_SERVICE_DRILL=1 probes/tier1.sh # skip the sweep-service drill
 set -o pipefail
 cd "$(dirname "$0")/.."
 T1_LOG="${T1_LOG:-/tmp/_t1.log}"
@@ -106,6 +107,62 @@ PYEOF
         echo "FUSED_LEDGER_DRILL=pass"
     else
         echo "FUSED_LEDGER_DRILL=FAIL"
+        rc=$(( rc == 0 ? 1 : rc ))
+    fi
+fi
+
+# -- sweep-service drill (resident multi-tenant scheduler, service/) --
+# Queue 3 sweeps on a spool, cancel the 3rd before it runs, start a
+# server and SIGTERM it mid-work (the active tenant drains at a
+# boundary and parks — exit 0, queue preserved on disk), restart the
+# server to completion, then assert: both live jobs `done`, the
+# cancelled one never ran, every tenant ledger passes report
+# --validate, and every tenant checkpoint tree audits fsck-clean.
+if [ -z "$T1_SKIP_SERVICE_DRILL" ]; then
+    sv_rc=0
+    SD=$(mktemp -d /tmp/_t1_svc.XXXXXX)
+    mop() { env JAX_PLATFORMS=cpu python -m mpi_opt_tpu "$@"; }
+    submit_job() {  # $1=tenant $2=seed $3=trials -> job id on stdout
+        mop submit --state-dir "$SD" --tenant "$1" -- \
+            --workload quadratic --algorithm random --trials "$3" \
+            --budget 3 --workers 1 --seed "$2" \
+            | python -c 'import json,sys; print(json.load(sys.stdin)["job"])'
+    }
+    J1=$(submit_job alice 0 24) || sv_rc=1
+    J2=$(submit_job bob 1 6) || sv_rc=1
+    J3=$(submit_job carol 2 6) || sv_rc=1
+    mop cancel "$J3" --state-dir "$SD" >/dev/null 2>&1 || sv_rc=1
+    timeout -k 10 180 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        serve --state-dir "$SD" --slice-boundaries 2 \
+        >/dev/null 2>&1 &
+    SRV=$!
+    sleep 10                       # let it get mid-slice on the big job
+    kill -TERM "$SRV" 2>/dev/null
+    wait "$SRV"; [ $? -eq 0 ] || sv_rc=1   # graceful drain, not a crash
+    timeout -k 10 240 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        serve --state-dir "$SD" --slice-boundaries 2 --drain-on-empty \
+        >/dev/null 2>&1 || sv_rc=1
+    mop status --state-dir "$SD" --json >"$SD/_status.json" 2>/dev/null || sv_rc=1
+    env J1="$J1" J2="$J2" J3="$J3" python - "$SD/_status.json" <<'PYEOF' || sv_rc=1
+import json, os, sys
+st = {j["job"]: j for j in json.load(open(sys.argv[1]))["jobs"]}
+assert st[os.environ["J1"]]["state"] == "done", st
+assert st[os.environ["J2"]]["state"] == "done", st
+assert st[os.environ["J3"]]["state"] == "cancelled", st
+assert st[os.environ["J3"]].get("slices") in (0, None), st  # never ran
+PYEOF
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        report "$SD" --validate >/dev/null 2>&1 || sv_rc=1
+    for ck in "$SD"/tenants/*/ckpt; do
+        [ -d "$ck" ] || continue
+        timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+            fsck "$ck" >/dev/null 2>&1 || sv_rc=1
+    done
+    rm -rf "$SD"
+    if [ $sv_rc -eq 0 ]; then
+        echo "SERVICE_DRILL=pass"
+    else
+        echo "SERVICE_DRILL=FAIL"
         rc=$(( rc == 0 ? 1 : rc ))
     fi
 fi
