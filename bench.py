@@ -84,6 +84,22 @@ def bench_tpu(args):
         member_chunk=args.member_chunk,
         gen_chunk=args.gen_chunk,
     )
+    # span tracing across warmup + measurement (opt-out: --no-trace):
+    # the attribution JSON rides in the bench record, so BENCH_r06+
+    # carries compile-vs-train-vs-save seconds — including the warmup
+    # compile wall the ROADMAP wants measured — beside trials/s
+    trace_prior = trace_metrics = trace_path = None
+    if not args.no_trace:
+        import tempfile
+
+        from mpi_opt_tpu.obs import trace as _trace
+        from mpi_opt_tpu.utils.metrics import MetricsLogger
+
+        trace_path = args.trace_file or os.path.join(
+            tempfile.mkdtemp(prefix="bench_trace_"), "bench.jsonl"
+        )
+        trace_metrics = MetricsLogger(path=trace_path)
+        trace_prior = _trace.configure(trace_metrics)
     # warmup is an IDENTICAL invocation: generations is a static jit arg
     # (scan length), so only the same-arg call guarantees the measured
     # run is a pure cache hit / steady-state execution
@@ -94,6 +110,15 @@ def bench_tpu(args):
         t0 = time.perf_counter()
         result = fused_pbt(wl, **kw)
         wall = time.perf_counter() - t0
+    trace_rep = None
+    if trace_prior is not None:
+        from mpi_opt_tpu.obs import trace as _trace
+        from mpi_opt_tpu.obs.report import bench_attribution
+
+        _trace.deconfigure(trace_prior)
+        trace_metrics.close()
+        trace_rep = bench_attribution(trace_path)
+        log(f"[bench] trace stream {trace_path}: coverage {trace_rep['coverage']}")
     trials = population * generations
     tps = trials / wall
     # flops accounting AFTER the timed window (it lowers/compiles tiny
@@ -131,6 +156,8 @@ def bench_tpu(args):
         "flops": flops,
         "mfu": util,
         "device": jax.devices()[0].device_kind,
+        "trace": trace_rep,
+        "trace_stream": trace_path if args.trace_file else None,
     }
 
 
@@ -402,6 +429,17 @@ def main():
         "host; see PERF_NOTES.md)",
     )
     p.add_argument("--profile-dir", default=None)
+    p.add_argument(
+        "--no-trace",
+        action="store_true",
+        help="measure without span tracing (drops the phase breakdown)",
+    )
+    p.add_argument(
+        "--trace-file",
+        default=None,
+        help="keep the span-trace stream here (default: a temp file — "
+        "only the attribution lands in the record)",
+    )
     args = p.parse_args()
 
     tpu = bench_tpu(args)
@@ -425,6 +463,11 @@ def main():
         "mfu": round(tpu["mfu"], 4) if tpu["mfu"] is not None else None,
         "platform_matmul_tflops": tpu["platform_matmul_tflops"],
         "mfu_vs_platform_cap": tpu["mfu_vs_platform_cap"],
+        # span-trace phase attribution (obs/): compile vs train vs save
+        # seconds + achieved TF/s per launch + time-to-first-trial —
+        # None under --no-trace
+        "trace": tpu["trace"],
+        "trace_stream": tpu["trace_stream"],
     }
     if args.skip_baseline:
         record["vs_baseline"] = 1.0

@@ -41,6 +41,33 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def traced_config(fn, trace_dir, config_id: int):
+    """Run one config under span tracing (obs/trace.py) and attach the
+    phase-attribution JSON to its record — BENCH_r06+ carries a
+    compile/train/save breakdown beside trials/s instead of one opaque
+    wall number. ``trace_dir=None`` runs untraced (--no-trace)."""
+    if trace_dir is None:
+        return fn()
+    import os
+
+    from mpi_opt_tpu.obs import trace as _trace
+    from mpi_opt_tpu.obs.report import bench_attribution
+    from mpi_opt_tpu.utils.metrics import MetricsLogger
+
+    os.makedirs(trace_dir, exist_ok=True)
+    path = os.path.join(trace_dir, f"config{config_id}.jsonl")
+    metrics = MetricsLogger(path=path)
+    prior = _trace.configure(metrics)
+    try:
+        rec = fn()
+    finally:
+        _trace.deconfigure(prior)
+        metrics.close()
+    rec["trace"] = bench_attribution(path)
+    rec["trace_stream"] = path
+    return rec
+
+
 def median_walls(fn, repeats: int = 5):
     """(median_wall, all_walls) over ``repeats`` timed calls of ``fn``.
 
@@ -493,6 +520,18 @@ def main():
                    "(chance=0.01; label-noise ceiling ~0.65, so 0.5 is "
                    "mid-curve and discriminates hyperparameters)")
     p.add_argument("--out", default="BENCH_ALL.json")
+    p.add_argument(
+        "--trace-dir",
+        default=None,
+        help="keep per-config span-trace streams here (default: a temp "
+        "dir — only the attribution lands in the record)",
+    )
+    p.add_argument(
+        "--no-trace",
+        action="store_true",
+        help="measure without span tracing (drops the per-config phase "
+        "breakdown from the records)",
+    )
     args = p.parse_args()
 
     runners = {
@@ -535,11 +574,16 @@ def main():
         with open(args.out, "w") as f:
             json.dump(records, f, indent=1)
 
+    import tempfile
+
+    trace_dir = None
+    if not args.no_trace:
+        trace_dir = args.trace_dir or tempfile.mkdtemp(prefix="bench_trace_")
     for c in wanted:
         log(f"[bench_all] config {c} ...")
         t0 = time.perf_counter()
         try:
-            rec = runners[c]()
+            rec = traced_config(runners[c], trace_dir, int(c))
         except Exception as e:  # keep measuring the rest; record the failure
             rec = {"config": int(c), "error": f"{type(e).__name__}: {e}"}
         rec["bench_wall_s"] = round(time.perf_counter() - t0, 1)

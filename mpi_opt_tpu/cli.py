@@ -21,6 +21,7 @@ from mpi_opt_tpu.driver import run_search
 from mpi_opt_tpu.health import SweepInterrupted
 from mpi_opt_tpu.health import heartbeat as _heartbeat
 from mpi_opt_tpu.health import shutdown as _shutdown
+from mpi_opt_tpu.obs import trace as _trace
 from mpi_opt_tpu.ops.pbt import PBTConfig
 from mpi_opt_tpu.utils import integrity
 from mpi_opt_tpu.utils.exitcodes import EX_DATAERR, EX_TEMPFAIL
@@ -175,6 +176,24 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="capture a jax.profiler trace of the search loop here "
         "(TensorBoard-loadable)",
+    )
+    p.add_argument(
+        "--profile-launches",
+        default=None,
+        metavar="N|A:B",
+        help="with --profile-dir: profile only this launch window "
+        "(1-based, inclusive — fused launches/rungs/generations, or "
+        "driver batches) instead of the whole run; e.g. 2:3 skips the "
+        "cold-compile first launch so the XLA trace shows steady state",
+    )
+    # span tracing (obs/; see README: Observability)
+    p.add_argument(
+        "--trace",
+        action="store_true",
+        help="emit span records (compile/train/staging/boundary/save/"
+        "journal phase durations, obs/trace.py) into the metrics stream "
+        "— give --metrics-file and render with `mpi_opt_tpu trace FILE`. "
+        "Off by default: an untraced sweep does zero tracing work",
     )
     # ASHA
     p.add_argument("--min-budget", type=int, default=10)
@@ -411,6 +430,27 @@ def _is_transient(e: BaseException) -> bool:
     return any(m in str(e).lower() for m in _TRANSIENT_MARKERS)
 
 
+def _wire_trace(args, metrics):
+    """Install this run's MetricsLogger as the span sink (obs/trace.py)
+    when --trace is set; returns the prior trace state (restored by
+    main's finally) or None when tracing is off. Rank tags come from
+    jax.process_index() under SPMD so multi-rank streams merge
+    attributably; the tenant tag comes from the service scheduler's
+    ``MPI_OPT_TPU_TRACE_TAG`` env around each slice."""
+    if not args.trace:
+        return None
+    import os
+
+    rank = 0
+    if args.multihost or args.coordinator is not None:
+        import jax
+
+        rank = jax.process_index()
+    return _trace.configure(
+        metrics, rank=rank, tenant=os.environ.get("MPI_OPT_TPU_TRACE_TAG")
+    )
+
+
 def _run_with_retries(launch, retries: int, metrics):
     """Run ``launch()``; on a transient runtime failure, retry up to
     ``retries`` times. Callers pass a closure over a fused sweep whose
@@ -603,6 +643,7 @@ def run_fused(args, parser, workload) -> int:
     n_chips = int(mesh.devices.size) if mesh is not None else 1
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
     _wire_integrity_observer(metrics)
+    _wire_trace(args, metrics)  # restored by main's finally
     from mpi_opt_tpu.ledger import LedgerError
 
     space = workload.default_space()
@@ -759,7 +800,9 @@ def _run_fused_dispatch(
 
     from mpi_opt_tpu.utils.profiling import profile_window
 
-    with profile_window(args.profile_dir):
+    # getattr: main() parses the window; direct in-process callers of
+    # run_fused (tests) may hand an argparse namespace without it
+    with profile_window(args.profile_dir, launches=getattr(args, "profile_window", None)):
         if args.algorithm == "pbt":
             from mpi_opt_tpu.train.fused_pbt import fused_pbt
 
@@ -947,6 +990,12 @@ def main(argv=None, *, _workload=None) -> int:
         from mpi_opt_tpu.utils.integrity import fsck_main
 
         return fsck_main(argv[1:])
+    # `mpi_opt_tpu trace FILE|DIR` renders phase-time attribution over
+    # JSONL metrics streams (obs/report.py); never touches jax
+    if argv and argv[0] == "trace":
+        from mpi_opt_tpu.obs.report import trace_main
+
+        return trace_main(argv[1:])
     # the resident multi-tenant sweep service (service/): `serve` is the
     # long-lived device-owning server, `submit`/`status`/`cancel`/`drain`
     # are the thin filesystem-spool clients (no network dependency)
@@ -992,6 +1041,18 @@ def main(argv=None, *, _workload=None) -> int:
                 "waves; combining it with --gen-chunk/--step-chunk "
                 "launch splitting is ambiguous"
             )
+    # --profile-launches: parse + validate as a usage error, and carry
+    # the parsed window on args for the profile_window call sites
+    args.profile_window = None
+    if args.profile_launches is not None:
+        if not args.profile_dir:
+            parser.error("--profile-launches requires --profile-dir")
+        from mpi_opt_tpu.utils.profiling import parse_launch_window
+
+        try:
+            args.profile_window = parse_launch_window(args.profile_launches)
+        except ValueError as e:
+            parser.error(f"--profile-launches: {e}")
     if args.isolate_stateful and (args.fused or args.backend != "cpu"):
         parser.error(
             "--isolate-stateful moves the cpu backend's in-parent "
@@ -1049,8 +1110,11 @@ def main(argv=None, *, _workload=None) -> int:
     # everything from here RUNS the sweep: arm the graceful-shutdown
     # protocol (SIGTERM/SIGINT set a drain flag; batch/launch boundaries
     # flush and exit EX_TEMPFAIL) and the optional progress heartbeat.
-    # Both are scoped: handlers restored and heartbeat dropped on the
-    # way out, so in-process callers (tests, embedders) see no residue.
+    # All three are scoped: handlers restored, heartbeat dropped, and
+    # the trace sink RESTORED to its entry state on the way out — a
+    # service tenant slice (in-process cli.main under serve --trace)
+    # must hand the server back its own sink, not a cleared one.
+    trace_entry = _trace.save()
     try:
         with _shutdown.ShutdownGuard():
             if args.heartbeat_file:
@@ -1059,6 +1123,7 @@ def main(argv=None, *, _workload=None) -> int:
     finally:
         _heartbeat.deconfigure()
         integrity.clear_observer()
+        _trace.deconfigure(trace_entry)
 
 
 def _run_sweep(args, parser, _workload=None) -> int:
@@ -1105,7 +1170,6 @@ def _run_sweep(args, parser, _workload=None) -> int:
     elif args.backend == "tpu":
         mesh = build_mesh(args)
         backend_kwargs = {"population": args.population, "seed": args.seed, "mesh": mesh}
-    backend = get_backend(args.backend, workload, **backend_kwargs)
     # the metric of record is trials/sec/CHIP; normalizing by 1 on a
     # multi-chip TPU run would overstate it by the chip count, and by
     # the device count on a --no-mesh run that only uses one device —
@@ -1116,8 +1180,14 @@ def _run_sweep(args, parser, _workload=None) -> int:
     n_chips = 1
     if args.backend == "tpu" and mesh is not None:
         n_chips = int(mesh.devices.size)
+    # metrics + tracing wire BEFORE backend construction so the pool
+    # bring-up (dataset load, worker spawn, device upload) lands in a
+    # setup span — it is most of a driver sweep's time-to-first-trial
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
     _wire_integrity_observer(metrics)
+    _wire_trace(args, metrics)  # restored by main's finally
+    with _trace.span("setup", backend=args.backend):
+        backend = get_backend(args.backend, workload, **backend_kwargs)
     checkpointer = None
     restored_step = None
     if args.checkpoint_dir:
@@ -1228,7 +1298,9 @@ def _run_sweep(args, parser, _workload=None) -> int:
         seed=args.seed,
     )
     try:
-        with profile_window(args.profile_dir):
+        with profile_window(
+            args.profile_dir, launches=getattr(args, "profile_window", None)
+        ):
             result = run_search(
                 algorithm,
                 backend,

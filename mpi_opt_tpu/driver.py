@@ -21,6 +21,8 @@ from mpi_opt_tpu.backends.base import Backend
 from mpi_opt_tpu.health import heartbeat, shutdown
 from mpi_opt_tpu.health.shutdown import SweepInterrupted
 from mpi_opt_tpu.ledger.store import result_from_record
+from mpi_opt_tpu.obs import trace
+from mpi_opt_tpu.utils import profiling
 from mpi_opt_tpu.trial import Trial, TrialResult
 from mpi_opt_tpu.utils.metrics import MetricsLogger, null_logger
 
@@ -164,14 +166,18 @@ class _FailureTracker:
                 # trial-level twin of the supervisor's rank watchdog,
                 # and the producer behind the summary's stalls_detected
                 self.metrics.count_stalls()
-            self.metrics.count_failure(r.status)
             self.metrics.log(
                 "trial_failed",
                 trial_id=r.trial_id,
                 status=r.status,
                 error=r.error,
                 step=r.step,
+                # the phase the driver was in when the failure was
+                # accounted (the stall satellite: "stalled during X",
+                # not a bare reap) — None outside any span
+                phase=trace.current_phase(),
             )
+            self.metrics.count_failure(r.status)
         if (
             self.policy.max_failure_rate < 1.0
             and self.evaluated >= self.policy.min_evals_for_abort
@@ -290,11 +296,17 @@ def run_search(
                     continue
             pending.append(t)
         if pending:
+            profiling.launch_tick()
             # tracker.evaluate owns metrics.count_trials for the batch
             # (it must tally even a batch whose abort check raises) and
-            # fires on_final per trial before that check
-            for r in tracker.evaluate(backend, pending, on_final=on_final):
-                served[r.trial_id] = r
+            # fires on_final per trial before that check. The train span
+            # is the driver path's launch-equivalent: backend.evaluate
+            # blocks until the batch's results exist, so dur_s is real
+            # batch wall (retries included); per-trial journal spans
+            # nest inside it via on_final
+            with trace.span("train", batch=batches + 1, members=len(pending)):
+                for r in tracker.evaluate(backend, pending, on_final=on_final):
+                    served[r.trial_id] = r
         algorithm.report_batch([served[t.trial_id] for t in batch])
         n_run += len(pending)
         best = algorithm.best()

@@ -43,8 +43,15 @@ class Heartbeat:
 
     def beat(self, **progress) -> Optional[dict]:
         """Record one unit of progress; returns the record (None if the
-        write failed — warned once, never raised). Thread-safe."""
+        write failed — warned once, never raised). Thread-safe.
+
+        ``phase`` is the beating thread's active trace span
+        (obs/trace.py; best-effort cross-thread fallback) — the field
+        that turns a stall report into "stalled during stage_in"
+        instead of a bare kill. None outside any span."""
         import threading
+
+        from mpi_opt_tpu.obs import trace
 
         with self._lock:
             self.beats += 1
@@ -53,6 +60,7 @@ class Heartbeat:
             "pid": os.getpid(),
             "beats": n,
             "ts": round(time.time(), 4),
+            "phase": trace.current_phase(),
             "progress": progress,
         }
         tmp = f"{self.path}.tmp{os.getpid()}.{threading.get_ident()}"

@@ -106,6 +106,24 @@ def _hb_path(log_dir: str, rank: int) -> str:
     return os.path.join(log_dir, f"rank{rank}.hb")
 
 
+def _stall_phases(log_dir: str, ranks) -> dict:
+    """``{rank: phase}`` for stalled ranks, from each rank's LAST beat
+    record: the ``phase`` field (the rank's active trace span at beat
+    time — obs/trace.py) with the beat's ``stage`` progress label as
+    fallback. Turns a bare "ranks [1] stalled" kill into "rank 1
+    stalled during stage_in". Unknown phases report None — the beat
+    predates the span layer or carried no phase."""
+    from mpi_opt_tpu.health.heartbeat import read_beat
+
+    phases = {}
+    for i in ranks:
+        rec = read_beat(_hb_path(log_dir, i)) or {}
+        phases[str(i)] = rec.get("phase") or (rec.get("progress") or {}).get(
+            "stage"
+        )
+    return phases
+
+
 def _spawn_ranks(n: int, rest: list[str], log_dir: str, heartbeat: bool = False):
     """One attempt's rank processes; a fresh coordinator port each time
     (the previous attempt's port may linger in TIME_WAIT). With
@@ -482,9 +500,17 @@ def main(argv=None) -> int:
                 return EX_TEMPFAIL
             if kind == "stall":
                 stalls += 1
+                # phase-tagged stall diagnostics: what each wedged rank
+                # was DOING when its beats froze ("stalled during
+                # stage_in"), from the last beat's active-span phase
+                phases = _stall_phases(log_dir, info)
+                phase_note = ", ".join(
+                    f"rank {r} during {p}" for r, p in phases.items() if p
+                )
                 _event(
                     "stall",
                     ranks=info,
+                    phases=phases,
                     stall_timeout=args.stall_timeout,
                     stalls_detected=stalls,
                 )
@@ -492,12 +518,15 @@ def main(argv=None) -> int:
                     _event(
                         "failed",
                         stalled_ranks=info,
+                        phases=phases,
                         attempts=attempt + 1,
                         stalls_detected=stalls,
                     )
                     sys.stderr.write(
                         f"ranks {info} stalled (no heartbeat progress in "
-                        f"{args.stall_timeout}s); retries exhausted.\n"
+                        f"{args.stall_timeout}s"
+                        + (f"; {phase_note}" if phase_note else "")
+                        + "); retries exhausted.\n"
                     )
                     return 1
                 if _crash_looping(attempt_wall):
@@ -510,6 +539,7 @@ def main(argv=None) -> int:
                 _event(
                     "stall_restart",
                     ranks=info,
+                    phases=phases,
                     attempt=attempt,
                     of=args.retries,
                     backoff_s=round(delay, 3),

@@ -81,7 +81,7 @@ def _service_status(path: str) -> Optional[dict]:
         return None
     if not isinstance(s, dict) or "state" not in s:
         return None
-    return {
+    out = {
         "job": s.get("id"),
         "tenant": s.get("tenant"),
         "state": s.get("state"),
@@ -89,6 +89,15 @@ def _service_status(path: str) -> Optional[dict]:
         "preemptions": s.get("preemptions"),
         "program_cache": s.get("program_cache"),
     }
+    # an ACTIVE tenant also reports what it is doing right now (phase
+    # from its heartbeat's active-span field + current slice elapsed) —
+    # one import, service-light (no jax)
+    from mpi_opt_tpu.service.spool import live_phase
+
+    live = live_phase(os.path.dirname(path), s)
+    if live is not None:
+        out.update(live)
+    return out
 
 
 def summarize_ledger(path: str) -> dict:
@@ -188,11 +197,17 @@ def _render_text(rep: dict) -> str:
     if rep.get("service"):
         s = rep["service"]
         pc = s.get("program_cache") or {}
+        live = ""
+        if s.get("state") == "running":
+            live = (
+                f" phase={s.get('phase')}"
+                f" slice_elapsed={s.get('slice_elapsed_s')}s"
+            )
         lines.append(
             f"  service: tenant={s.get('tenant')} job={s.get('job')} "
             f"state={s.get('state')} slices={s.get('slices')} "
             f"preemptions={s.get('preemptions')} "
-            f"cache={pc.get('hits', 0)}h/{pc.get('misses', 0)}m"
+            f"cache={pc.get('hits', 0)}h/{pc.get('misses', 0)}m" + live
         )
     if rep["torn_tail_dropped"]:
         lines.append("  note: 1 torn tail line dropped (crash mid-append)")

@@ -445,7 +445,14 @@ class SweepLedger:
         attempts: int = 1,
         cached: bool = False,
     ) -> dict:
-        """Journal one FINAL result; durable (fsync) before returning."""
+        """Journal one FINAL result; durable (fsync) before returning.
+
+        Traced as one ``journal`` span per record (the driver path's
+        per-trial fsync — fused member records instead share one span
+        per boundary in train/common.journal_boundary, where a pop-1024
+        generation would otherwise emit 1024 span lines)."""
+        from mpi_opt_tpu.obs import trace
+
         if self.header is None:
             raise LedgerError("ledger has no header — call ensure_header first")
         score = float(result.score)
@@ -467,7 +474,8 @@ class SweepLedger:
             "ts": round(time.time(), 4),
         }
         if not self.read_only:
-            self._write_line(rec)
+            with trace.span("journal", n=1):
+                self._write_line(rec)
         # read-only ranks still track the record in memory: completed()
         # and the dedup views must agree with rank 0's across the gang
         self.records.append(rec)

@@ -1,0 +1,21 @@
+"""Observability: span tracing + phase-time attribution (ISSUE 8).
+
+The paper's metric of record is wall-clock-to-target, but until this
+layer the system could only measure totals — the ~2x kernel gap and the
+140-210 s warmup (PERF_NOTES.md) were known from hand-run probes, not
+from anything the system emits. ``obs`` closes that: library code wraps
+its hot phases in ``trace.span("phase", ...)`` context managers that
+emit rank-tagged, ``ts``-correlatable duration records into the
+existing JSONL metrics stream, and ``mpi_opt_tpu trace FILE|DIR``
+renders a phase-attribution table (wall %, p50/p95, achieved TF/s,
+time-to-first-trial) over one or many streams.
+
+Modules:
+- ``trace``   — the tracer: ``span``/``traced``/``configure``; costs
+  nothing when no sink is configured (the ``null_logger`` contract).
+- ``events``  — the registry of every legal event/span name; a tier-1
+  test walks the codebase and fails on an unregistered name.
+- ``report``  — the ``trace`` subcommand (merge by ``ts``, attribute).
+"""
+
+from mpi_opt_tpu.obs import trace  # noqa: F401

@@ -1,0 +1,170 @@
+"""The event/span name registry: one table of every legal name.
+
+The metrics stream is a de-facto schema consumed by the trace CLI,
+benches, the launch supervisor's relay, and outside log aggregation —
+and it has already drifted silently once (``ts`` was added ad hoc in
+PR 2). This module is the stop: every ``metrics.log("name", ...)``
+event, every ``integrity.notify("name", ...)``, every supervisor
+``_event("name", ...)`` and every ``trace.span("name", ...)`` must use
+a name registered here. A tier-1 test (tests/test_obs.py) walks the
+codebase with ``scan_call_sites`` and fails on any literal call-site
+name missing from the tables — adding an event means adding one line
+here, which is the point: the schema change becomes a reviewed diff.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+#: every legal ``event`` value in the JSONL metrics stream (including
+#: launch.py's supervisor events and utils/integrity.py observer
+#: notifications, which land in the same consumable stream shape)
+EVENTS = frozenset(
+    {
+        # driver / sweep lifecycle
+        "batch",
+        "resume",
+        "retry",
+        "retry_exhausted",
+        "summary",
+        "sweep_aborted",
+        "preempt_drain",
+        "trial_failed",
+        "trial_retry",
+        "warm_start",
+        "warm_start_skipped",
+        # ledger layer
+        "ledger_rank_gated",
+        "ledger_replay",
+        "ledger_replay_unconsumed",
+        "ledger_torn_boundary_dropped",
+        "ledger_torn_tail_dropped",
+        # snapshot-integrity observer (utils/integrity.py)
+        "snapshot_corrupt",
+        "snapshot_io_retry",
+        "snapshot_unverified",
+        # launch.py supervisor events
+        "launch",
+        "done",
+        "failed",
+        "restart",
+        "stall",
+        "stall_restart",
+        "preempted",
+        "preempt_restart",
+        # sweep service (service/scheduler.py)
+        "serve_start",
+        "slice_start",
+        "slice_end",
+        "tenant_admit",
+        "tenant_cancelled",
+        "tenant_recovered",
+        "tenant_reject",
+        # span tracing (obs/trace.py): one event kind, span names below
+        "span",
+    }
+)
+
+#: every legal ``span`` name (the ``span`` field of a ``span`` event)
+SPANS = frozenset(
+    {
+        "setup",  # workload data load + trainer/backend construction
+        "compile",  # XLA compile (cache attr: cold | persistent)
+        "train",  # one fused train launch / one driver evaluate batch
+        "boundary",  # exploit / rung cut / generation-boundary op
+        "stage_in",  # host->device wave upload (train/staging.py)
+        "stage_out",  # device->host wave fetch + pool write
+        "stage_wait",  # main-thread drain() block (un-hidden transfer)
+        "save",  # orbax snapshot save (digest + enqueue)
+        "save_wait",  # checkpointer close: async-save drain
+        "restore",  # orbax snapshot restore attempt
+        "digest",  # integrity manifest build / verification
+        "journal",  # ledger fsync (per final trial / per fused boundary)
+        "slice",  # one service scheduling quantum (server side)
+        "slice_setup",  # service program-cache acquire + log open
+    }
+)
+
+
+def is_event(name: str) -> bool:
+    return name in EVENTS
+
+
+def is_span(name: str) -> bool:
+    return name in SPANS
+
+
+def _callee_kind(fn) -> str:
+    """"event"/"span"/"" for a call's func node. ``log`` counts only as
+    an ATTRIBUTE call (``metrics.log``) — bench.py's bare ``log(msg)``
+    stderr helper is not an event emitter; ``notify``/``span``/``traced``
+    count in both spellings; ``_event`` is launch.py's bare helper."""
+    if isinstance(fn, ast.Attribute):
+        name, is_attr = fn.attr, True
+    elif isinstance(fn, ast.Name):
+        name, is_attr = fn.id, False
+    else:
+        return ""
+    if name == "log" and is_attr:
+        return "event"
+    if name in ("notify", "_event"):
+        return "event"
+    if name in ("span", "traced"):
+        return "span"
+    return ""
+
+
+def scan_call_sites(root: str):
+    """Walk ``root`` for Python files (tests excluded — they fabricate
+    names on purpose) and yield ``(path, lineno, kind, name)`` for every
+    call site whose first argument is a string literal and whose callee
+    is one of the registered emitters:
+
+    - kind ``"event"``: ``*.log("name", ...)``, ``notify("name", ...)``,
+      ``*._event(...)`` / ``_event("name", ...)``;
+    - kind ``"span"``: ``span("name", ...)`` / ``trace.span(...)`` /
+      ``@traced("name")``.
+
+    Non-literal first arguments are skipped (re-emission helpers like
+    the integrity observer forward a variable). The tier-1 registry
+    lint (tests/test_obs.py) is the one consumer.
+    """
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d
+            for d in dirnames
+            if d not in ("__pycache__", ".git", "tests", "probes", "node_modules")
+        ]
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                with open(path) as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                first = node.args[0]
+                if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+                    continue
+                kind = _callee_kind(node.func)
+                if kind:
+                    yield path, node.lineno, kind, first.value
+
+
+def lint(root: str) -> list:
+    """Human-readable problems for unregistered names under ``root``
+    (empty = clean). The tier-1 gate wraps this."""
+    problems = []
+    for path, lineno, kind, name in scan_call_sites(root):
+        table = EVENTS if kind == "event" else SPANS
+        if name not in table:
+            problems.append(
+                f"{path}:{lineno}: unregistered {kind} name {name!r} — "
+                f"add it to obs/events.py {'EVENTS' if kind == 'event' else 'SPANS'}"
+            )
+    return problems

@@ -1,0 +1,423 @@
+"""``mpi_opt_tpu trace FILE|DIR`` — phase-time attribution over metrics
+streams.
+
+Input is one or more JSONL metrics streams (``--metrics-file`` output;
+a DIRECTORY is walked for streams — point it at a launch.py ``--log-dir``
+or a service ``--state-dir`` and every rank's/tenant's stream merges).
+Records are merged by absolute ``ts`` (the cross-process correlator
+every record carries since PR 2) and span records (obs/trace.py) are
+attributed:
+
+- per-phase wall: count, total (inclusive) seconds, self (exclusive)
+  seconds, percent of wall, p50/p95 span duration;
+- compile breakdown: cold XLA compiles vs persistent-cache hits (an
+  in-process jit-cache hit emits no compile span — its absence under a
+  ``train`` span IS the jit-cache signal);
+- achieved TF/s: ``train`` spans carry workload FLOP counts
+  (train/common.segment_flops_hint); attribution divides by measured
+  span time, per launch and overall — the number PERF_NOTES could only
+  get from hand probes;
+- time-to-first-trial: first completed train launch / driver batch
+  relative to the stream's start — the warm-start metric the ROADMAP
+  wants measured.
+
+Coverage (attributed self-seconds / wall) can legitimately exceed 100%
+when a background transfer thread overlaps compute — that overlap is
+the staging engine doing its job, and burying it would hide the win.
+
+``--json`` prints one machine-readable object (the bench/CI surface);
+text mode renders the table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Optional
+
+_MAX_SNIFF_LINES = 20
+
+
+def sniff_stream(path: str) -> bool:
+    """Is ``path`` a metrics stream? One JSON object per line carrying
+    an ``event`` key (a ledger's lines carry ``kind`` instead — the
+    trace CLI must not ingest journals as phase data). Mixed files
+    (rank logs with non-JSON lines around the stream) still sniff true
+    if any early line matches."""
+    try:
+        with open(path, "r", errors="replace") as f:
+            for _ in range(_MAX_SNIFF_LINES):
+                line = f.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line or not line.startswith("{"):
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and "event" in rec:
+                    return True
+    except OSError:
+        return False
+    return False
+
+
+def discover_streams(directory: str) -> list:
+    """Metrics streams under ``directory``: ``.jsonl``/``.out``/``.log``
+    files that sniff as streams (launch.py rank logs are ``rank{i}.out``;
+    service tenants write ``metrics.jsonl``).
+
+    A service tenant's ``run.log`` captures the tenant's STDOUT copy of
+    the same stream its ``metrics.jsonl`` holds (stdout_logger writes
+    both) — ingesting both would double-count every span, so when a
+    directory holds a sniffing ``metrics.jsonl``, its ``run.log`` is
+    skipped. Rank ``.out`` logs have no metrics-file sibling and are
+    kept."""
+    found = []
+    for root, _dirs, files in os.walk(directory):
+        has_metrics = "metrics.jsonl" in files and sniff_stream(
+            os.path.join(root, "metrics.jsonl")
+        )
+        for f in files:
+            if not f.endswith((".jsonl", ".out", ".log")):
+                continue
+            if f == "run.log" and has_metrics:
+                continue
+            path = os.path.join(root, f)
+            if sniff_stream(path):
+                found.append(path)
+    return sorted(found)
+
+
+def load_stream(path: str) -> list:
+    """Every parseable event record in ``path`` (non-JSON lines and
+    non-event JSON — summaries' sibling shapes, stray prints — are
+    skipped: a rank log legitimately mixes streams)."""
+    records = []
+    with open(path, "r", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line or not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and "event" in rec and "ts" in rec:
+                records.append(rec)
+    return records
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _is_span(rec: dict) -> bool:
+    return (
+        rec.get("event") == "span"
+        and isinstance(rec.get("span"), str)
+        and isinstance(rec.get("dur_s"), (int, float))
+    )
+
+
+def _begin(rec: dict) -> float:
+    ts = float(rec["ts"])
+    return ts - float(rec["dur_s"]) if _is_span(rec) else ts
+
+
+def _phase_table(spans: list, wall: float) -> dict:
+    phases: dict = {}
+    for r in spans:
+        phases.setdefault(r["span"], []).append(r)
+    out = {}
+    for name in sorted(phases):
+        group = phases[name]
+        durs = sorted(float(r["dur_s"]) for r in group)
+        self_s = sum(float(r.get("self_s", r["dur_s"])) for r in group)
+        out[name] = {
+            "count": len(group),
+            "total_s": round(sum(durs), 4),
+            "self_s": round(self_s, 4),
+            "wall_pct": round(100.0 * self_s / wall, 2) if wall > 0 else None,
+            "p50_s": round(_percentile(durs, 0.50), 4),
+            "p95_s": round(_percentile(durs, 0.95), 4),
+        }
+    return out
+
+
+def _train_throughput(spans: list) -> Optional[dict]:
+    """Achieved TF/s from flops-carrying train spans (None when no span
+    carried a FLOP count — e.g. the backend's cost analysis was
+    unavailable)."""
+    train = [
+        r
+        for r in spans
+        if r["span"] == "train" and isinstance(r.get("flops"), (int, float))
+    ]
+    if not train:
+        return None
+    per_launch = []
+    for r in sorted(train, key=lambda r: (r.get("ts", 0.0))):
+        d = float(r["dur_s"])
+        per_launch.append(
+            {
+                "launch": r.get("launch"),
+                "dur_s": round(d, 4),
+                "flops": float(r["flops"]),
+                "tflops_per_sec": round(float(r["flops"]) / d / 1e12, 4)
+                if d > 0
+                else None,
+            }
+        )
+    flops = sum(e["flops"] for e in per_launch)
+    dur = sum(e["dur_s"] for e in per_launch)
+    return {
+        "flops": flops,
+        "train_s": round(dur, 4),
+        "tflops_per_sec": round(flops / dur / 1e12, 4) if dur > 0 else None,
+        "per_launch": per_launch,
+    }
+
+
+def _time_to_first_trial(records: list, t_start: float) -> Optional[float]:
+    """Seconds from the stream's first record to the first completed
+    trial evidence: the end of the first ``train`` span (a fused launch
+    completes population x generations member-trials) or the first
+    driver ``batch`` event."""
+    marks = [
+        float(r["ts"])
+        for r in records
+        if (_is_span(r) and r["span"] == "train") or r.get("event") == "batch"
+    ]
+    if not marks:
+        return None
+    return round(min(marks) - t_start, 4)
+
+
+def _stream_summary(label: str, records: list) -> Optional[dict]:
+    if not records:
+        return None
+    t_start = min(_begin(r) for r in records)
+    t_end = max(float(r["ts"]) for r in records)
+    wall = max(0.0, t_end - t_start)
+    spans = [r for r in records if _is_span(r)]
+    self_total = sum(float(r.get("self_s", r["dur_s"])) for r in spans)
+    ranks = sorted({r["rank"] for r in spans if "rank" in r})
+    tenants = sorted({r["tenant"] for r in spans if "tenant" in r})
+    return {
+        "label": label,
+        "records": len(records),
+        "span_records": len(spans),
+        "wall_s": round(wall, 4),
+        "t_start": round(t_start, 4),
+        "t_end": round(t_end, 4),
+        "rank": ranks[0] if len(ranks) == 1 else (ranks or None),
+        "tenant": tenants[0] if len(tenants) == 1 else (tenants or None),
+        "coverage": round(self_total / wall, 4) if wall > 0 else None,
+        "time_to_first_trial_s": _time_to_first_trial(records, t_start),
+    }
+
+
+def attribute(streams: dict) -> dict:
+    """The full attribution over ``{label: records}`` streams, merged by
+    absolute ``ts``. Returns the ``--json`` object."""
+    merged = []
+    stream_summaries = []
+    for label in sorted(streams):
+        records = streams[label]
+        s = _stream_summary(label, records)
+        if s is not None:
+            stream_summaries.append(s)
+        merged.extend(records)
+    merged.sort(key=lambda r: float(r["ts"]))
+    spans = [r for r in merged if _is_span(r)]
+    if merged:
+        t_start = min(_begin(r) for r in merged)
+        t_end = max(float(r["ts"]) for r in merged)
+        wall = max(0.0, t_end - t_start)
+    else:
+        wall = 0.0
+    self_total = sum(float(r.get("self_s", r["dur_s"])) for r in spans)
+    compile_spans = [r for r in spans if r["span"] == "compile"]
+    compile_rep = {}
+    for kind in ("cold", "persistent"):
+        group = [r for r in compile_spans if r.get("cache") == kind]
+        compile_rep[kind] = {
+            "count": len(group),
+            "total_s": round(sum(float(r["dur_s"]) for r in group), 4),
+        }
+    tenants = sorted({r["tenant"] for r in spans if "tenant" in r})
+    per_tenant = None
+    if tenants:
+        per_tenant = {
+            t: _phase_table([r for r in spans if r.get("tenant") == t], wall)
+            for t in tenants
+        }
+    ttft = [
+        (s["label"], s["time_to_first_trial_s"])
+        for s in stream_summaries
+        if s["time_to_first_trial_s"] is not None
+    ]
+    return {
+        "streams": stream_summaries,
+        "records": len(merged),
+        "span_records": len(spans),
+        "wall_s": round(wall, 4),
+        "attributed_s": round(self_total, 4),
+        "coverage": round(self_total / wall, 4) if wall > 0 else None,
+        "phases": _phase_table(spans, wall),
+        "compile": compile_rep,
+        "train": _train_throughput(spans),
+        "time_to_first_trial_s": min((v for _l, v in ttft), default=None),
+        "tenants": per_tenant,
+    }
+
+
+def bench_attribution(path: str) -> dict:
+    """The compact attribution subset benches embed beside trials/s
+    (bench.py and bench_all.py both consume THIS, so the record shape
+    cannot drift between the two harnesses)."""
+    rep = attribute({os.path.basename(path): load_stream(path)})
+    return {
+        k: rep.get(k)
+        for k in (
+            "wall_s",
+            "coverage",
+            "phases",
+            "compile",
+            "train",
+            "time_to_first_trial_s",
+        )
+    }
+
+
+def _render_text(rep: dict) -> str:
+    lines = [
+        f"trace: {len(rep['streams'])} stream(s), {rep['records']} records "
+        f"({rep['span_records']} spans), wall {rep['wall_s']}s"
+        + (
+            f", {round(100.0 * rep['coverage'], 1)}% attributed"
+            if rep["coverage"] is not None
+            else ""
+        )
+    ]
+    if rep["phases"]:
+        lines.append(
+            f"  {'phase':<12} {'count':>6} {'total s':>9} {'self s':>9} "
+            f"{'wall %':>7} {'p50 s':>8} {'p95 s':>8}"
+        )
+        for name, p in sorted(
+            rep["phases"].items(), key=lambda kv: -kv[1]["self_s"]
+        ):
+            pct = "-" if p["wall_pct"] is None else f"{p['wall_pct']:.1f}"
+            lines.append(
+                f"  {name:<12} {p['count']:>6} {p['total_s']:>9.3f} "
+                f"{p['self_s']:>9.3f} {pct:>7} {p['p50_s']:>8.4f} {p['p95_s']:>8.4f}"
+            )
+    c = rep["compile"]
+    if c.get("cold", {}).get("count") or c.get("persistent", {}).get("count"):
+        lines.append(
+            f"  compile: {c['cold']['count']} cold ({c['cold']['total_s']}s), "
+            f"{c['persistent']['count']} persistent-cache hits "
+            f"({c['persistent']['total_s']}s); train launches without a "
+            "compile span hit the in-process jit cache"
+        )
+    t = rep["train"]
+    if t is not None and t["tflops_per_sec"] is not None:
+        lines.append(
+            f"  train: {t['tflops_per_sec']} TF/s achieved "
+            f"({t['flops']:.3e} FLOPs over {t['train_s']}s)"
+        )
+        for e in t["per_launch"]:
+            if e["launch"] is not None:
+                lines.append(
+                    f"    launch {e['launch']}: {e['dur_s']}s, "
+                    f"{e['tflops_per_sec']} TF/s"
+                )
+    if rep["time_to_first_trial_s"] is not None:
+        lines.append(f"  time to first trial: {rep['time_to_first_trial_s']}s")
+    if rep["tenants"]:
+        for name, table in sorted(rep["tenants"].items()):
+            busy = round(sum(p["self_s"] for p in table.values()), 3)
+            top = sorted(table.items(), key=lambda kv: -kv[1]["self_s"])[:3]
+            top_s = ", ".join(f"{n} {p['self_s']}s" for n, p in top)
+            lines.append(f"  tenant {name}: {busy}s attributed ({top_s})")
+    for s in rep["streams"]:
+        if len(rep["streams"]) > 1:
+            lines.append(
+                f"  stream {s['label']}: wall {s['wall_s']}s, "
+                f"{s['span_records']} spans"
+                + (
+                    f", first trial at {s['time_to_first_trial_s']}s"
+                    if s["time_to_first_trial_s"] is not None
+                    else ""
+                )
+            )
+    return "\n".join(lines)
+
+
+def trace_main(argv=None) -> int:
+    """The ``mpi_opt_tpu trace`` subcommand (see cli.main dispatch)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="mpi_opt_tpu trace",
+        description="phase-time attribution over JSONL metrics streams "
+        "(see README: Observability)",
+    )
+    p.add_argument(
+        "targets",
+        nargs="+",
+        metavar="FILE|DIR",
+        help="metrics stream(s) (--metrics-file output), or directories "
+        "to discover streams under (a launch --log-dir merges all ranks; "
+        "a service --state-dir merges all tenants)",
+    )
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    args = p.parse_args(argv)
+
+    streams: dict = {}
+
+    def add(label, path):
+        # labels must stay UNIQUE: two directory targets can both hold
+        # a "metrics.jsonl", and a silent dict overwrite would report
+        # one tenant's records as if they covered both — disambiguate
+        # with the full path instead
+        if label in streams:
+            label = path
+        streams[label] = load_stream(path)
+
+    rc = 0
+    for target in args.targets:
+        if os.path.isdir(target):
+            hits = discover_streams(target)
+            if not hits:
+                print(f"{target}: no metrics streams found", file=sys.stderr)
+                rc = 1
+            for path in hits:
+                add(os.path.relpath(path, target), path)
+        else:
+            try:
+                add(target, target)
+            except OSError as e:
+                print(f"{target}: {e}", file=sys.stderr)
+                rc = 1
+    if not any(streams.values()):
+        if streams:
+            print("no event records found in the given streams", file=sys.stderr)
+            rc = 1
+        if args.json:
+            print(json.dumps({"streams": [], "records": 0, "phases": {}}))
+        return rc
+    rep = attribute(streams)
+    if args.json:
+        print(json.dumps(rep))
+    else:
+        print(_render_text(rep))
+    return rc

@@ -80,6 +80,14 @@ def serve_main(argv) -> int:
         "(batch/drill mode; without it the server stays resident)",
     )
     p.add_argument(
+        "--trace",
+        action="store_true",
+        help="span-trace every tenant slice into the tenant's own "
+        "metrics.jsonl (tenant-tagged records) and the server's "
+        "scheduling into server-metrics.jsonl; render the whole "
+        "multi-tenant picture with `mpi_opt_tpu trace STATE_DIR`",
+    )
+    p.add_argument(
         "--platform",
         default=None,
         choices=["cpu", "tpu"],
@@ -120,6 +128,7 @@ def serve_main(argv) -> int:
         poll_seconds=args.poll_seconds,
         drain_on_empty=args.drain_on_empty,
         metrics_stream=sys.stdout,
+        trace=args.trace,
     )
     try:
         return service.serve()
@@ -181,21 +190,28 @@ def _collect_status(spool: Spool) -> dict:
                 "state": tstates.QUEUED,
             }
         )
+    from mpi_opt_tpu.service.spool import live_phase
+
     for t in spool.tenants():
         s = t.status
-        jobs.append(
-            {
-                "job": t.job_id,
-                "tenant": s.get("tenant", "default"),
-                "state": s.get("state"),
-                "slices": s.get("slices"),
-                "preemptions": s.get("preemptions"),
-                "boundaries": s.get("boundaries"),
-                "best_score": s.get("best_score"),
-                "program_cache": s.get("program_cache"),
-                "first_slice_wall_s": s.get("first_slice_wall_s"),
-            }
-        )
+        job = {
+            "job": t.job_id,
+            "tenant": s.get("tenant", "default"),
+            "state": s.get("state"),
+            "slices": s.get("slices"),
+            "preemptions": s.get("preemptions"),
+            "boundaries": s.get("boundaries"),
+            "best_score": s.get("best_score"),
+            "program_cache": s.get("program_cache"),
+            "first_slice_wall_s": s.get("first_slice_wall_s"),
+        }
+        # an ACTIVE tenant surfaces what it is doing right now: the
+        # phase from its heartbeat (fed by the active trace span) and
+        # how long the current slice has been on the device
+        live = live_phase(t.dir, s)
+        if live is not None:
+            job.update(live)
+        jobs.append(job)
     return {
         "state_dir": spool.state_dir,
         "server": {
@@ -238,6 +254,13 @@ def status_main(argv) -> int:
             pc = j.get("program_cache") or {}
             if pc.get("hits") or pc.get("misses"):
                 extra += f" cache={pc.get('hits', 0)}h/{pc.get('misses', 0)}m"
+        if j.get("state") == "running" and (
+            j.get("phase") or j.get("slice_elapsed_s") is not None
+        ):
+            extra += (
+                f" phase={j.get('phase')}"
+                f" slice_elapsed={j.get('slice_elapsed_s')}s"
+            )
         print(f"  {j['job']}  tenant={j['tenant']}  {j['state']}{extra}")
     return 0
 

@@ -86,6 +86,7 @@ class SweepService:
         metrics_stream=None,
         on_boundary: Optional[Callable] = None,
         on_slice_end: Optional[Callable] = None,
+        trace: bool = False,
     ):
         if slice_boundaries < 1:
             raise ValueError(f"slice_boundaries must be >= 1, got {slice_boundaries}")
@@ -100,6 +101,11 @@ class SweepService:
         self.poll_seconds = poll_seconds
         self.drain_on_empty = drain_on_empty
         self.programs = ProgramCache()
+        # serve --trace: every tenant slice runs with span tracing into
+        # its own tenant-dir metrics stream (tenant-tagged records), and
+        # the server's own scheduling spans go to server-metrics.jsonl —
+        # `mpi_opt_tpu trace STATE_DIR` merges the lot by ts
+        self.trace = bool(trace)
         # test/drill seams: on_boundary(tenant, stage, n) fires from the
         # slice hook (deterministic injection point for drills that need
         # "mid-slice" timing); on_slice_end(tenant) after classification
@@ -283,13 +289,22 @@ class SweepService:
         # slices=0 with durable state already on disk — a fresh (non
         # -resume) retry would trip the CLI's stale-state refusal
         # (exit 2) and terminally fail a perfectly recoverable tenant
-        return list(t.job["argv"]) + [
+        argv = list(t.job["argv"]) + [
             "--ledger",
             t.ledger,
             "--checkpoint-dir",
             t.ckpt,
             "--resume",
+            # per-tenant heartbeat (server-owned, like --ledger): beat
+            # records carry the rank's active span phase, which is what
+            # the status/report clients surface as an ACTIVE tenant's
+            # live phase (spool.live_phase)
+            "--heartbeat-file",
+            t.heartbeat,
         ]
+        if self.trace:
+            argv += ["--metrics-file", t.metrics, "--trace"]
+        return argv
 
     def _run_slice(self, t: TenantDir) -> Optional[str]:
         """One scheduling quantum on the device. Returns the REAL signal
@@ -319,6 +334,8 @@ class SweepService:
             self._retire_usage(status)
             self.metrics.log("tenant_reject", job=t.job_id, error=str(e))
             return None
+        from mpi_opt_tpu.obs import trace
+
         try:
             # acquire builds the shared workload instance on first use
             # (get_workload -> cls(): dataset caches, disk, arbitrary
@@ -327,9 +344,10 @@ class SweepService:
             # unreadable-job.json case above: the tenant is still
             # RUNNABLE at this point, so letting the exception out would
             # crash-loop every restarted server on the same pick
-            key, cache_hit, workload = self.programs.acquire(argv)
-            log_start = os.path.getsize(t.log) if os.path.exists(t.log) else 0
-            logf = open(t.log, "a")
+            with trace.span("slice_setup", job=t.job_id):
+                key, cache_hit, workload = self.programs.acquire(argv)
+                log_start = os.path.getsize(t.log) if os.path.exists(t.log) else 0
+                logf = open(t.log, "a")
         except Exception as e:
             t.write_status(
                 dict(status, state=tstates.FAILED, note=f"slice setup failed: {e}")
@@ -338,7 +356,11 @@ class SweepService:
             self._retire_usage(status)
             self.metrics.log("tenant_reject", job=t.job_id, error=str(e))
             return None
-        t.write_status(dict(status, state=tstates.RUNNING))
+        # slice_started_ts: the live-phase surface's elapsed anchor
+        # (spool.live_phase reads it back while the slice runs)
+        t.write_status(
+            dict(status, state=tstates.RUNNING, slice_started_ts=round(time.time(), 4))
+        )
         self._wrote_status(t)
         self.metrics.log(
             "slice_start",
@@ -380,8 +402,21 @@ class SweepService:
         # full quantum before the server notices (the hook above and the
         # post-slice read both depend on it surviving)
         shutdown.set_slice_hook(hook)
+        # tenant tag for the slice's span records: cli.main's trace
+        # wiring reads it, so a merged state-dir trace attributes phases
+        # per tenant. Env (not a flag) because the spool's job argv must
+        # stay exactly what the client submitted. Only touched under
+        # serve --trace, and the operator's own pre-existing value is
+        # restored afterwards — the slice must be env-side-effect-free.
+        prev_tag = os.environ.get("MPI_OPT_TPU_TRACE_TAG")
+        if self.trace:
+            os.environ["MPI_OPT_TPU_TRACE_TAG"] = status.get("tenant", "default")
+        # the slice span emits AFTER cli.main restores the server's own
+        # sink (trace nesting contract), so it lands in the SERVER
+        # stream with the tenant's in-slice spans as its children
+        _slice_span = trace.span("slice", job=t.job_id)
         try:
-            with logf:
+            with _slice_span, logf:
                 logf.write(f"--- slice {int(status.get('slices') or 0) + 1} ---\n")
                 with contextlib.redirect_stdout(logf), contextlib.redirect_stderr(
                     logf
@@ -409,6 +444,11 @@ class SweepService:
                         rc = 1
         finally:
             shutdown.clear_slice_hook()
+            if self.trace:
+                if prev_tag is None:
+                    os.environ.pop("MPI_OPT_TPU_TRACE_TAG", None)
+                else:
+                    os.environ["MPI_OPT_TPU_TRACE_TAG"] = prev_tag
         wall = time.perf_counter() - t0
         delivered = shutdown.delivered_signal()
 
@@ -507,6 +547,14 @@ class SweepService:
         # open THIS server's signal-observation window: a signal a
         # previous in-process server (or sweep) absorbed is not ours
         shutdown.clear_delivered()
+        trace_prior = None
+        if self.trace:
+            # server-side spans (slice/slice_setup) go to the server's
+            # own stream; each tenant slice re-configures to its tenant
+            # stream and cli.main restores this sink on the way out
+            from mpi_opt_tpu.obs import trace
+
+            trace_prior = trace.configure(self.metrics)
         self._recover_stale_running()
         self.metrics.log(
             "serve_start",
@@ -542,6 +590,10 @@ class SweepService:
                         reason = f"signal {delivered}"
                         break
         finally:
+            if self.trace:
+                from mpi_opt_tpu.obs import trace
+
+                trace.deconfigure(trace_prior)
             self.spool.clear_server()
             self.metrics.summary(final=True, reason=reason)
             self.metrics.close()

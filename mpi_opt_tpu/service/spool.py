@@ -128,6 +128,12 @@ class TenantDir:
         self.ledger = os.path.join(self.dir, "ledger.jsonl")
         self.ckpt = os.path.join(self.dir, "ckpt")
         self.log = os.path.join(self.dir, "run.log")
+        # observability surfaces (both server-owned, like ledger/ckpt):
+        # the heartbeat's phase field is the ACTIVE tenant's live-phase
+        # source; metrics.jsonl is the tenant's span-trace stream under
+        # `serve --trace` (renders with `mpi_opt_tpu trace STATE_DIR`)
+        self.heartbeat = os.path.join(self.dir, "heartbeat.json")
+        self.metrics = os.path.join(self.dir, "metrics.jsonl")
 
     @property
     def job(self) -> dict:
@@ -150,6 +156,33 @@ class TenantDir:
     def request_cancel(self) -> None:
         with open(self.cancel_path, "w") as f:
             f.write("")
+
+
+def live_phase(tenant_dir: str, status: dict) -> Optional[dict]:
+    """An ACTIVE tenant's live phase + slice-elapsed, for the status and
+    report surfaces: ``{"phase": ..., "slice_elapsed_s": ...}`` when the
+    status says ``running``, else None.
+
+    The phase comes from the tenant's heartbeat file (the scheduler
+    wires ``--heartbeat-file`` into every slice): each beat carries the
+    rank's active trace span (health/heartbeat.py ``phase``) with the
+    beat's progress ``stage`` label as fallback. Slice elapsed is
+    against the ``slice_started_ts`` the scheduler stamps into the
+    RUNNING status write. Read-only and best-effort — a pre-upgrade
+    status or a beat-less slice reports None fields, never an error."""
+    if status.get("state") != "running":
+        return None
+    from mpi_opt_tpu.health.heartbeat import read_beat
+
+    rec = read_beat(os.path.join(tenant_dir, "heartbeat.json")) or {}
+    out = {
+        "phase": rec.get("phase") or (rec.get("progress") or {}).get("stage"),
+        "slice_elapsed_s": None,
+    }
+    started = status.get("slice_started_ts")
+    if started is not None:
+        out["slice_elapsed_s"] = round(max(0.0, time.time() - float(started)), 3)
+    return out
 
 
 class Spool:

@@ -5,6 +5,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from mpi_opt_tpu.obs import trace
+
 
 def workload_arrays(workload, member_chunk: int = 0, mesh=None):
     """(trainer, space, train_x, train_y, val_x, val_y) for a population
@@ -31,26 +33,30 @@ def workload_arrays(workload, member_chunk: int = 0, mesh=None):
     key = (member_chunk, mesh, mdt)
     cache = getattr(workload, "_fused_cache", None)
     if cache is None or cache[0] != key:
-        d = workload.data()
-        arrays = (
-            jnp.asarray(d["train_x"]),
-            jnp.asarray(d["train_y"]),
-            jnp.asarray(d["val_x"]),
-            jnp.asarray(d["val_y"]),
-        )
-        if mesh is not None:
-            from mpi_opt_tpu.parallel.mesh import replicate
+        # setup span: dataset load + upload + trainer build — the cold
+        # pre-first-launch time the trace CLI must attribute (it is part
+        # of time-to-first-trial, and invisible without a span)
+        with trace.span("setup", workload=getattr(workload, "name", None)):
+            d = workload.data()
+            arrays = (
+                jnp.asarray(d["train_x"]),
+                jnp.asarray(d["train_y"]),
+                jnp.asarray(d["val_x"]),
+                jnp.asarray(d["val_y"]),
+            )
+            if mesh is not None:
+                from mpi_opt_tpu.parallel.mesh import replicate
 
-            rep = replicate(mesh)
-            arrays = tuple(jax.device_put(a, rep) for a in arrays)
-        workload._fused_cache = (
-            key,
-            workload.make_trainer(
-                member_chunk=member_chunk, mesh=mesh, momentum_dtype=mdt
-            ),
-            workload.default_space(),
-            *arrays,
-        )
+                rep = replicate(mesh)
+                arrays = tuple(jax.device_put(a, rep) for a in arrays)
+            workload._fused_cache = (
+                key,
+                workload.make_trainer(
+                    member_chunk=member_chunk, mesh=mesh, momentum_dtype=mdt
+                ),
+                workload.default_space(),
+                *arrays,
+            )
     return workload._fused_cache[1:]
 
 
@@ -123,7 +129,11 @@ def journal_boundary(journal, b_local: int, members, units, scores, step: int) -
     journal instead of re-writing (ledger/fused.py)."""
     if journal is None:
         return
-    journal.record_boundary(b_local, members, units, scores, step)
+    # one journal span per boundary (not per member record: a pop-1024
+    # generation journals 1024 fsync'd lines — span volume must stay
+    # proportional to boundaries, not members)
+    with trace.span("journal", boundary=int(b_local), n=len(members)):
+        journal.record_boundary(b_local, members, units, scores, step)
 
 
 def journal_require_prefix(journal, n_boundaries: int) -> None:
@@ -141,6 +151,42 @@ def make_fused_journal(ledger, space, **offsets):
     from mpi_opt_tpu.ledger.fused import make_journal
 
     return make_journal(ledger, space, **offsets)
+
+
+def segment_flops_hint(workload, population: int, steps: int):
+    """Per-boundary FLOPs (one train segment of ``population`` members
+    for ``steps`` steps + one eval pass) for the trace layer's achieved-
+    TF/s attribution — the number that turns the 33-of-157 TF/s kernel
+    gap (PERF_NOTES) into something the system REPORTS per launch.
+
+    Only computed when tracing is enabled (the probe lowers tiny
+    one-member programs through XLA's cost analysis —
+    utils.flops.population_sweep_flops — which an untraced sweep must
+    not pay), cached per (population, steps) on the workload instance,
+    and probe compiles are span-suppressed so they don't pollute the
+    very attribution they serve. None when tracing is off or the
+    backend offers no cost analysis; callers then omit the ``flops``
+    span attr and the trace CLI reports TF/s as unavailable.
+    """
+    if not trace.enabled():
+        return None
+    cache = getattr(workload, "_flops_hint_cache", None)
+    if cache is None:
+        cache = workload._flops_hint_cache = {}
+    key = (int(population), int(steps))
+    if key not in cache:
+        from mpi_opt_tpu.utils.flops import population_sweep_flops
+
+        # the probe's own wall is attributed as setup (it is real
+        # pre-train time of a traced sweep); the tiny programs it
+        # lowers are span-SUPPRESSED so their compiles don't count as
+        # the sweep's compile phase
+        with trace.span("setup", op="flops_probe", members=int(population)):
+            with trace.suppressed():
+                cache[key] = population_sweep_flops(
+                    workload, int(population), 1, int(steps), n_evals=1
+                )
+    return cache[key]
 
 
 class HParamsFn:

@@ -32,6 +32,7 @@ import hashlib
 import jax
 import numpy as np
 
+from mpi_opt_tpu.obs import trace
 from mpi_opt_tpu.ops.asha import asha_cut, asha_rungs
 from mpi_opt_tpu.train.common import (
     finite_winner,
@@ -40,8 +41,10 @@ from mpi_opt_tpu.train.common import (
     launch_boundary,
     make_fused_journal,
     momentum_dtype_str,
+    segment_flops_hint,
     workload_arrays,
 )
+from mpi_opt_tpu.utils import profiling
 
 
 @functools.partial(jax.jit, static_argnames=("trainer", "eta", "k"))
@@ -254,15 +257,38 @@ def fused_sha(
             budget = rungs[r]
             prev_budget = rungs[r - 1] if r > 0 else 0
             k_run, k_seg = jax.random.split(k_run)
-            hp = workload.make_hparams(space.from_unit(unit))
-            state, _ = trainer.train_segment(
-                state, hp, train_x, train_y, k_seg, budget - prev_budget
+            profiling.launch_tick()
+            # eager mode's score fetch is the rung's completion barrier,
+            # so the span's duration is real and carries flops for
+            # achieved TF/s; deferred mode dispatches async (the span
+            # measures dispatch — no flops attr, TF/s would be bogus)
+            # hint probed OUTSIDE the span (its one-time cost must not
+            # inflate the first rung's measured duration)...
+            f = None if defer else segment_flops_hint(
+                workload, sizes[r], budget - prev_budget
             )
-            scores = trainer.eval_population(state, val_x, val_y)
-            if defer:
-                rung_scores_dev.append(scores)
-            else:
-                np_scores = fetch_global(scores)
+            with trace.span(
+                "train",
+                launch=boundary_offset + r + 1,
+                rung=r + 1,
+                members=sizes[r],
+                steps=budget - prev_budget,
+            ) as sp:
+                hp = workload.make_hparams(space.from_unit(unit))
+                state, _ = trainer.train_segment(
+                    state, hp, train_x, train_y, k_seg, budget - prev_budget
+                )
+                scores = trainer.eval_population(state, val_x, val_y)
+                if defer:
+                    rung_scores_dev.append(scores)
+                else:
+                    np_scores = fetch_global(scores)
+                    # ...and attached only AFTER the fetch barrier: a
+                    # rung that raised mid-span must not report
+                    # full-rung FLOPs over a partial duration
+                    if f:
+                        sp["flops"] = f
+            if not defer:
                 record_rung(r, np_scores)
                 if journal is not None:
                     # one member record per PRE-cut survivor at this
@@ -272,23 +298,26 @@ def fused_sha(
                         step=budget,
                     )
             if r < len(rungs) - 1:
-                state, unit, keep, _ = _cut_and_gather(
-                    trainer, state, unit, scores, eta, sizes[r + 1]
-                )
-                if mesh is not None:
-                    # re-place: the gather may leave survivors unsharded/skewed
-                    state = shard_popstate(state, mesh)
-                    unit = place_pop(unit, mesh)
-                if defer:
-                    rung_keep_dev.append(keep)
-                else:
-                    np_keep = fetch_global(keep)
-                    alive = alive[np_keep]
-                    # post-cut survivors' scores, for a resume-at-complete
-                    # result (np_scores already holds this rung's fetch —
-                    # re-fetching would pay an extra cross-process allgather
-                    # per rung under multi-host)
-                    np_scores = np_scores[np_keep]
+                with trace.span("boundary", op="rung_cut", rung=r + 1):
+                    state, unit, keep, _ = _cut_and_gather(
+                        trainer, state, unit, scores, eta, sizes[r + 1]
+                    )
+                    if mesh is not None:
+                        # re-place: the gather may leave survivors
+                        # unsharded/skewed
+                        state = shard_popstate(state, mesh)
+                        unit = place_pop(unit, mesh)
+                    if defer:
+                        rung_keep_dev.append(keep)
+                    else:
+                        np_keep = fetch_global(keep)
+                        alive = alive[np_keep]
+                        # post-cut survivors' scores, for a
+                        # resume-at-complete result (np_scores already
+                        # holds this rung's fetch — re-fetching would pay
+                        # an extra cross-process allgather per rung under
+                        # multi-host)
+                        np_scores = np_scores[np_keep]
             if snap is not None:
                 # scores saved = the CURRENT cohort rows (post-cut when cut)
                 snap.save_population_sweep(

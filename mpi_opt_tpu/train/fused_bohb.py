@@ -93,11 +93,16 @@ def fused_bohb(
         # count bounded by the fixed bracket plan and cache-stable
         # across runs/resumes; the first n_model rows are used (the
         # batch is diversified, so any prefix is a valid draw set)
-        sugg, _ = suggest(
-            k_model, s["unit"], s["score"], s["valid"], n_suggest=n, cfg=cfg
-        )
-        cohort = uniform
-        cohort[from_model] = np.asarray(sugg)[:n_model]
+        from mpi_opt_tpu.obs import trace
+
+        with trace.span("boundary", op="suggest", bracket=b, n=n):
+            sugg, _ = suggest(
+                k_model, s["unit"], s["score"], s["valid"], n_suggest=n, cfg=cfg
+            )
+            cohort = uniform
+            # the np.asarray conversion is the suggest's completion
+            # barrier — inside the span so its duration is real
+            cohort[from_model] = np.asarray(sugg)[:n_model]
         return cohort, n_model
 
     def observe_fn(b: int, cohort: np.ndarray, res: dict):

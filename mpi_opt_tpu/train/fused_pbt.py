@@ -31,6 +31,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+from mpi_opt_tpu.obs import trace
 from mpi_opt_tpu.ops.pbt import PBTConfig, pbt_exploit_explore
 from mpi_opt_tpu.train.common import (
     finite_winner,
@@ -39,7 +40,9 @@ from mpi_opt_tpu.train.common import (
     launch_boundary,
     make_fused_journal,
     momentum_dtype_str,
+    segment_flops_hint,
 )
+from mpi_opt_tpu.utils import profiling
 from mpi_opt_tpu.train.population import OptHParams, PopState, PopulationTrainer
 
 
@@ -238,8 +241,11 @@ def _run_wave(
 
             st = shard_popstate(st, mesh)
     else:
-        dev = stage_in(pool, rows, mesh)
-        engine.note_bytes(tree_bytes(dev))
+        with trace.span("stage_in", members=w) as sp:
+            dev = stage_in(pool, rows, mesh)
+            n_bytes = tree_bytes(dev)
+            sp["bytes"] = n_bytes
+        engine.note_bytes(n_bytes)
         st = PopState(params=dev["params"], momentum=dev["momentum"], step=dev["step"])
     st, _ = _wave_train_program(
         trainer,
@@ -428,6 +434,9 @@ def _fused_pbt_waves(
 
     snapshot_every = max(1, snapshot_every)
     engine = StagingEngine()
+    # per-generation FLOPs for the trace layer's achieved-TF/s (None
+    # when tracing is off — the probe is never paid untraced)
+    flops_gen = segment_flops_hint(workload, population, steps_per_gen)
 
     def _writer(off):
         def on_host(host):
@@ -460,98 +469,114 @@ def _fused_pbt_waves(
                     off, wl_ = offs[w], wave_lens[w]
                     # completed waves' scores round-trip exactly (f32)
                     wave_scores[w] = jnp.asarray(scores_host[off : off + wl_])
-            for w in range(w0, n_waves):
-                off, wl_ = offs[w], wave_lens[w]
-                st, sc = _run_wave(
-                    trainer,
-                    pool_front,
-                    perm[off : off + wl_],
-                    off,
-                    unit,
-                    hparams_fn,
-                    train_x,
-                    train_y,
-                    val_x,
-                    val_y,
-                    k_train,
-                    steps_per_gen,
-                    population,
-                    mesh,
-                    engine,
-                    init_keys=member_keys[off : off + wl_] if g == 0 else None,
-                    sample_x=train_x[:2],
-                )
-                wave_scores[w] = sc
-                # per-wave liveness (ROADMAP follow-up): beat as soon as
-                # the wave's programs are dispatched, so a stall timeout
-                # sized to one wave also covers the generation's LAST
-                # wave (whose next boundary beat waits on the full drain
-                # + exploit)
-                from mpi_opt_tpu.health import heartbeat
+            def _train_generation():
+                for w in range(w0, n_waves):
+                    off, wl_ = offs[w], wave_lens[w]
+                    st, sc = _run_wave(
+                        trainer,
+                        pool_front,
+                        perm[off : off + wl_],
+                        off,
+                        unit,
+                        hparams_fn,
+                        train_x,
+                        train_y,
+                        val_x,
+                        val_y,
+                        k_train,
+                        steps_per_gen,
+                        population,
+                        mesh,
+                        engine,
+                        init_keys=member_keys[off : off + wl_] if g == 0 else None,
+                        sample_x=train_x[:2],
+                    )
+                    wave_scores[w] = sc
+                    # per-wave liveness (ROADMAP follow-up): beat as soon as
+                    # the wave's programs are dispatched, so a stall timeout
+                    # sized to one wave also covers the generation's LAST
+                    # wave (whose next boundary beat waits on the full drain
+                    # + exploit)
+                    from mpi_opt_tpu.health import heartbeat
 
-                heartbeat.beat(
-                    stage=f"pbt gen {g + 1}/{generations} wave "
-                    f"{w + 1}/{n_waves} dispatched"
-                )
-                # async stage-out: the background fetch blocks on THIS
-                # wave's compute while the loop dispatches the next wave
-                engine.stage_out(
-                    {
-                        "state": {
-                            "params": st.params,
-                            "momentum": st.momentum,
-                            "step": st.step,
+                    heartbeat.beat(
+                        stage=f"pbt gen {g + 1}/{generations} wave "
+                        f"{w + 1}/{n_waves} dispatched"
+                    )
+                    # async stage-out: the background fetch blocks on THIS
+                    # wave's compute while the loop dispatches the next wave
+                    engine.stage_out(
+                        {
+                            "state": {
+                                "params": st.params,
+                                "momentum": st.momentum,
+                                "step": st.step,
+                            },
+                            "scores": sc,
                         },
-                        "scores": sc,
-                    },
-                    _writer(off),
-                )
-
-                def save_midgen(g=g, w=w):
-                    engine.drain()  # pools must hold every completed wave
-                    # COPY the pools: orbax's save is async, and the live
-                    # buffers are mutated in place by later waves' stage-out
-                    # writers — handing them over uncopied can tear the
-                    # snapshot (same contract as the resident path's
-                    # host-fetch-before-save)
-                    snap.save(
-                        g * n_waves + w + 1,
-                        sweep={
-                            "front": jax.tree.map(np.array, pool_front),
-                            "back": jax.tree.map(np.array, pool_back),
-                            "perm": np.asarray(perm),
-                            "unit": fetch_global(unit),
-                            "key_data": np.asarray(jax.random.key_data(k_gen)),
-                            "scores": scores_host.copy(),
-                        },
-                        meta_extra={
-                            "gen": g,
-                            "waves_done": w + 1,
-                            # a mid-generation snapshot completes no
-                            # boundary: only g generations are journaled
-                            "boundaries_done": g,
-                            "best": best_list,
-                            "mean": mean_list,
-                            "member_fail": fail_list,
-                            "gen_walls": gen_walls,
-                            "wall_partial": time.perf_counter() - t_gen + gen_partial0,
-                        },
+                        _writer(off),
                     )
 
-                if w + 1 < n_waves:
-                    # between-waves service point: heartbeat + graceful
-                    # drain with a mid-generation snapshot (completed
-                    # waves are never re-trained on resume)
-                    launch_boundary(
-                        f"pbt gen {g + 1}/{generations} wave {w + 1}/{n_waves}",
-                        final=False,
-                        snapshot=None if snap is None else save_midgen,
-                        launch=g * n_waves + w + 1,
-                        of=generations * n_waves,
-                    )
-            # generation boundary: the ONLY hard transfer barrier —
-            # exploit needs the full score vector and a settled pool
-            engine.drain()
+                    def save_midgen(g=g, w=w):
+                        engine.drain()  # pools must hold every completed wave
+                        # COPY the pools: orbax's save is async, and the live
+                        # buffers are mutated in place by later waves' stage-out
+                        # writers — handing them over uncopied can tear the
+                        # snapshot (same contract as the resident path's
+                        # host-fetch-before-save)
+                        snap.save(
+                            g * n_waves + w + 1,
+                            sweep={
+                                "front": jax.tree.map(np.array, pool_front),
+                                "back": jax.tree.map(np.array, pool_back),
+                                "perm": np.asarray(perm),
+                                "unit": fetch_global(unit),
+                                "key_data": np.asarray(jax.random.key_data(k_gen)),
+                                "scores": scores_host.copy(),
+                            },
+                            meta_extra={
+                                "gen": g,
+                                "waves_done": w + 1,
+                                # a mid-generation snapshot completes no
+                                # boundary: only g generations are journaled
+                                "boundaries_done": g,
+                                "best": best_list,
+                                "mean": mean_list,
+                                "member_fail": fail_list,
+                                "gen_walls": gen_walls,
+                                "wall_partial": time.perf_counter() - t_gen + gen_partial0,
+                            },
+                        )
+
+                    if w + 1 < n_waves:
+                        # between-waves service point: heartbeat + graceful
+                        # drain with a mid-generation snapshot (completed
+                        # waves are never re-trained on resume)
+                        launch_boundary(
+                            f"pbt gen {g + 1}/{generations} wave {w + 1}/{n_waves}",
+                            final=False,
+                            snapshot=None if snap is None else save_midgen,
+                            launch=g * n_waves + w + 1,
+                            of=generations * n_waves,
+                        )
+                # generation boundary: the ONLY hard transfer barrier —
+                # exploit needs the full score vector and a settled pool
+                engine.drain()
+
+            # the generation's train span covers every wave dispatch AND
+            # the drain barrier, so its duration is the generation's real
+            # compute+transfer wall; nested stage_in/stage_out/stage_wait/
+            # save spans subtract from its self time. ``flops`` makes the
+            # trace CLI report achieved TF/s per generation.
+            profiling.launch_tick()
+            with trace.span("train", launch=g + 1, gens=1, waves=n_waves) as sp:
+                _train_generation()
+                # flops only AFTER the drain barrier completed: a
+                # generation interrupted between waves emits its real
+                # partial duration WITHOUT the attr, so the trace CLI
+                # never divides full-generation FLOPs by partial wall
+                if flops_gen:
+                    sp["flops"] = flops_gen
             # journal this generation's members (pre-exploit scores +
             # the units they trained with) BEFORE the boundary snapshot;
             # a resumed generation verifies instead of re-writing
@@ -564,15 +589,18 @@ def _fused_pbt_waves(
                 step=(g + 1) * steps_per_gen,
             )
             scores_dev = jnp.concatenate([jnp.asarray(s) for s in wave_scores])
-            new_unit, src_idx, best, mean, n_fail, post = _wave_exploit(
-                k_pbt, unit, scores_dev, discrete_mask=disc, cfg=cfg
-            )
-            best_list.append(float(best))
-            mean_list.append(float(mean))
-            fail_list.append(int(n_fail))
-            unit = new_unit
-            perm = np.asarray(src_idx)
-            post_scores = np.asarray(post)
+            with trace.span("boundary", op="exploit", gen=g + 1):
+                new_unit, src_idx, best, mean, n_fail, post = _wave_exploit(
+                    k_pbt, unit, scores_dev, discrete_mask=disc, cfg=cfg
+                )
+                # the host conversions below ARE the exploit's completion
+                # barrier — inside the span so its duration is real
+                best_list.append(float(best))
+                mean_list.append(float(mean))
+                fail_list.append(int(n_fail))
+                unit = new_unit
+                perm = np.asarray(src_idx)
+                post_scores = np.asarray(post)
             pool_front, pool_back = pool_back, pool_front
             gen_walls.append(time.perf_counter() - t_gen + gen_partial0)
             is_last = g + 1 == generations
@@ -694,11 +722,12 @@ def _run_stepped_generation(
         # beats, so launch.py's --stall-timeout can be sized to one
         # step_chunk instead of a whole generation's train_segment scan
         heartbeat.beat(stage=f"pbt train sub-launch {i + 1}/{len(sub_lens)}")
-    state, unit, best, mean, n_fail, gen_scores, pre_scores, pre_unit = (
-        finish_generation(
-            trainer, state, unit, k_pbt, val_x, val_y, discrete_mask=disc, cfg=cfg
+    with trace.span("boundary", op="exploit"):
+        state, unit, best, mean, n_fail, gen_scores, pre_scores, pre_unit = (
+            finish_generation(
+                trainer, state, unit, k_pbt, val_x, val_y, discrete_mask=disc, cfg=cfg
+            )
         )
-    )
     return (
         state, unit, key, best[None], mean[None], n_fail[None], gen_scores,
         pre_scores[None], pre_unit[None],
@@ -965,53 +994,66 @@ def fused_pbt(
     snapshot_every = max(1, snapshot_every)
     import time
 
+    # per-generation FLOPs for the trace layer's achieved-TF/s spans
+    # (None when tracing is off — the probe is never paid untraced)
+    flops_gen = segment_flops_hint(workload, population, steps_per_gen)
     try:
         for i in range(start_launch, n_launches):
+            profiling.launch_tick()
             t_launch = time.perf_counter()
-            if step_chunk > 0:
-                # one generation as k sub-segment launches + a boundary
-                # launch; the carried key advances exactly once per gen
-                state, unit, k_run, best, mean, fails, final_scores, pre_s, pre_u = _run_stepped_generation(
-                    trainer,
-                    state,
-                    unit,
-                    hparams_fn,
-                    train_x,
-                    train_y,
-                    val_x,
-                    val_y,
-                    k_run,
-                    disc,
-                    steps_per_gen,
-                    step_chunk,
-                    cfg,
-                )
-            else:
-                # k_run is the scan-carried key returned by the previous
-                # launch: the chain continues exactly as one longer scan
-                # would
-                state, unit, k_run, best, mean, fails, final_scores, pre_s, pre_u = run_fused_pbt(
-                    trainer,
-                    state,
-                    unit,
-                    hparams_fn,
-                    train_x=train_x,
-                    train_y=train_y,
-                    val_x=val_x,
-                    val_y=val_y,
-                    key=k_run,
-                    discrete_mask=disc,
-                    generations=launch_lens[i],
-                    steps_per_gen=steps_per_gen,
-                    cfg=cfg,
-                )
-            # curves to host eagerly: they are tiny, and a later crash
-            # must not lose completed launches' history (fetch_global:
-            # under multi-process SPMD these are global arrays)
-            best_parts.append(fetch_global(best))
-            mean_parts.append(fetch_global(mean))
-            fail_parts.append(fetch_global(fails))
-            scores = fetch_global(final_scores)
+            # the launch's train span covers dispatch AND the curve
+            # fetches (the launch completion barrier), so dur_s is the
+            # launch's real wall and flops/dur_s is achieved TF/s
+            with trace.span("train", launch=i + 1, gens=launch_lens[i]) as _sp:
+                if step_chunk > 0:
+                    # one generation as k sub-segment launches + a boundary
+                    # launch; the carried key advances exactly once per gen
+                    state, unit, k_run, best, mean, fails, final_scores, pre_s, pre_u = _run_stepped_generation(
+                        trainer,
+                        state,
+                        unit,
+                        hparams_fn,
+                        train_x,
+                        train_y,
+                        val_x,
+                        val_y,
+                        k_run,
+                        disc,
+                        steps_per_gen,
+                        step_chunk,
+                        cfg,
+                    )
+                else:
+                    # k_run is the scan-carried key returned by the previous
+                    # launch: the chain continues exactly as one longer scan
+                    # would
+                    state, unit, k_run, best, mean, fails, final_scores, pre_s, pre_u = run_fused_pbt(
+                        trainer,
+                        state,
+                        unit,
+                        hparams_fn,
+                        train_x=train_x,
+                        train_y=train_y,
+                        val_x=val_x,
+                        val_y=val_y,
+                        key=k_run,
+                        discrete_mask=disc,
+                        generations=launch_lens[i],
+                        steps_per_gen=steps_per_gen,
+                        cfg=cfg,
+                    )
+                # curves to host eagerly: they are tiny, and a later crash
+                # must not lose completed launches' history (fetch_global:
+                # under multi-process SPMD these are global arrays)
+                best_parts.append(fetch_global(best))
+                mean_parts.append(fetch_global(mean))
+                fail_parts.append(fetch_global(fails))
+                scores = fetch_global(final_scores)
+                # flops only after the fetch barrier completed: a launch
+                # that raised mid-span emits its partial duration
+                # WITHOUT the attr (no inflated TF/s from partial work)
+                if flops_gen:
+                    _sp["flops"] = flops_gen * launch_lens[i]
             # the fetches above are the launch's completion barrier
             # (block_until_ready is unreliable under the axon plugin —
             # PERF_NOTES.md), so the duration is measured AFTER them and

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mpi_opt_tpu.obs import trace
 from mpi_opt_tpu.ops.tpe import TPEConfig, tpe_suggest
 from mpi_opt_tpu.train.common import (
     finite_winner,
@@ -33,8 +34,10 @@ from mpi_opt_tpu.train.common import (
     launch_boundary,
     make_fused_journal,
     momentum_dtype_str,
+    segment_flops_hint,
     workload_arrays,
 )
+from mpi_opt_tpu.utils import profiling
 
 
 @functools.partial(
@@ -235,41 +238,57 @@ def fused_tpe(
     fail_dev: list = []
     try:
         for g in range(start_gen, len(sizes)):
-            obs_unit, obs_scores, valid, key, scores, sugg = tpe_generation(
-                trainer,
-                obs_unit,
-                obs_scores,
-                valid,
-                hparams_fn,
-                train_x,
-                train_y,
-                val_x,
-                val_y,
-                key,
-                jnp.int32(done),
-                n_suggest=sizes[g],
-                budget=budget,
-                cfg=cfg,
-            )
-            done += sizes[g]
-            # valid alone is not enough: one valid-but-NaN observation
-            # would propagate through jnp.max into every later curve
-            # point — gate on finiteness too (same rule as best_i below)
-            running_dev = jnp.max(
-                jnp.where(valid & jnp.isfinite(obs_scores) & live, obs_scores, -jnp.inf)
-            )
-            # this generation's diverged-suggestion count (ROADMAP open
-            # item): the obs ring masks non-finite scores from the model,
-            # but operators need the tally the masking hides
-            fail_dev_g = jnp.sum(~jnp.isfinite(scores)).astype(jnp.int32)
-            if defer:
-                curve_dev.append(running_dev)
-                fail_dev.append(fail_dev_g)
-            else:
-                # fetch_global: under multi-process SPMD the buffer is a
-                # process-spanning (replicated) global array
-                best_curve.append(float(fetch_global(running_dev)))
-                member_fail.append(int(fetch_global(fail_dev_g)))
+            profiling.launch_tick()
+            # eager mode's curve fetch is the batch's completion barrier
+            # (real duration -> flops attr for achieved TF/s); deferred
+            # mode dispatches async, so the span carries no flops. The
+            # hint probes OUTSIDE the span (one-time cost must not
+            # inflate the first batch's duration), attaches only after
+            # the barrier (a crashed batch must not report full-batch
+            # FLOPs over a partial duration).
+            f = None if defer else segment_flops_hint(workload, sizes[g], budget)
+            with trace.span(
+                "train", launch=g + 1, members=sizes[g], steps=budget
+            ) as sp:
+                obs_unit, obs_scores, valid, key, scores, sugg = tpe_generation(
+                    trainer,
+                    obs_unit,
+                    obs_scores,
+                    valid,
+                    hparams_fn,
+                    train_x,
+                    train_y,
+                    val_x,
+                    val_y,
+                    key,
+                    jnp.int32(done),
+                    n_suggest=sizes[g],
+                    budget=budget,
+                    cfg=cfg,
+                )
+                done += sizes[g]
+                # valid alone is not enough: one valid-but-NaN observation
+                # would propagate through jnp.max into every later curve
+                # point — gate on finiteness too (same rule as best_i below)
+                running_dev = jnp.max(
+                    jnp.where(
+                        valid & jnp.isfinite(obs_scores) & live, obs_scores, -jnp.inf
+                    )
+                )
+                # this generation's diverged-suggestion count (ROADMAP open
+                # item): the obs ring masks non-finite scores from the model,
+                # but operators need the tally the masking hides
+                fail_dev_g = jnp.sum(~jnp.isfinite(scores)).astype(jnp.int32)
+                if defer:
+                    curve_dev.append(running_dev)
+                    fail_dev.append(fail_dev_g)
+                else:
+                    # fetch_global: under multi-process SPMD the buffer is a
+                    # process-spanning (replicated) global array
+                    best_curve.append(float(fetch_global(running_dev)))
+                    member_fail.append(int(fetch_global(fail_dev_g)))
+                    if f:
+                        sp["flops"] = f
             if journal is not None:
                 # one record per suggestion of this batch (members are
                 # the sweep's global trial indices), journaled BEFORE

@@ -148,6 +148,7 @@ class StagingEngine:
 
     def _loop(self):
         from mpi_opt_tpu.health import heartbeat
+        from mpi_opt_tpu.obs import trace
 
         while True:
             job = self._q.get()
@@ -156,24 +157,34 @@ class StagingEngine:
             tree, on_host = job
             t0 = time.perf_counter()
             try:
-                # device_get blocks until the arrays' producing programs
-                # finish — this IS the wave's completion barrier, paid
-                # on this thread while the main thread dispatches ahead
-                host = jax.device_get(tree)
-                on_host(host)
-                with self._lock:
-                    self.staged_bytes += tree_bytes(host)
-                    self.transfers += 1
-                    n = self.transfers
-                # per-transfer liveness: the main thread parks in
-                # drain() at generation boundaries, so without beats
-                # from HERE a hung host<->device stage (dead tunnel,
-                # wedged runtime) freezes the wave silently until the
-                # whole-generation timeout — with them, launch.py's
-                # --stall-timeout can be sized to one wave's transfer
-                # (heartbeat.beat is thread-safe; no-op when the CLI
-                # configured no heartbeat file)
-                heartbeat.beat(stage="staging transfer", transfers=n)
+                # the stage_out span runs on THIS thread (obs/trace.py is
+                # thread-safe): because device_get doubles as the wave's
+                # completion barrier, its duration carries compute-wait +
+                # transfer — overlap analysis reads it against the main
+                # thread's train/stage_wait spans by timestamp
+                with trace.span("stage_out") as sp:
+                    # device_get blocks until the arrays' producing programs
+                    # finish — this IS the wave's completion barrier, paid
+                    # on this thread while the main thread dispatches ahead
+                    host = jax.device_get(tree)
+                    on_host(host)
+                    n_bytes = tree_bytes(host)
+                    sp["bytes"] = n_bytes
+                    with self._lock:
+                        self.staged_bytes += n_bytes
+                        self.transfers += 1
+                        n = self.transfers
+                    # per-transfer liveness: the main thread parks in
+                    # drain() at generation boundaries, so without beats
+                    # from HERE a hung host<->device stage (dead tunnel,
+                    # wedged runtime) freezes the wave silently until the
+                    # whole-generation timeout — with them, launch.py's
+                    # --stall-timeout can be sized to one wave's transfer.
+                    # Beaten INSIDE the span so the beat's phase field
+                    # reads "stage_out" — what a stall report shows.
+                    # (heartbeat.beat is thread-safe; no-op when the CLI
+                    # configured no heartbeat file)
+                    heartbeat.beat(stage="staging transfer", transfers=n)
             except BaseException as e:  # surfaced by drain()
                 with self._lock:
                     self._errors.append(e)
@@ -203,14 +214,19 @@ class StagingEngine:
     def drain(self) -> None:
         """Completion barrier: block until all enqueued transfers are
         done; re-raise the first worker error. Block time is accounted
-        as un-hidden transfer cost (``wait_s``)."""
+        as un-hidden transfer cost (``wait_s``) and traced as a
+        ``stage_wait`` span — the staging cost compute did NOT hide,
+        now a number the trace CLI reports instead of a summed counter."""
+        from mpi_opt_tpu.obs import trace
+
         t0 = time.perf_counter()
-        with self._idle:
-            while self._pending:
-                self._idle.wait(timeout=0.5)
-            self.wait_s += time.perf_counter() - t0
-            if self._errors:
-                raise self._errors[0]
+        with trace.span("stage_wait"):
+            with self._idle:
+                while self._pending:
+                    self._idle.wait(timeout=0.5)
+                self.wait_s += time.perf_counter() - t0
+                if self._errors:
+                    raise self._errors[0]
 
     @property
     def overlap_s(self) -> float:

@@ -37,6 +37,7 @@ from typing import Any, Optional
 
 import orbax.checkpoint as ocp
 
+from mpi_opt_tpu.obs import trace
 from mpi_opt_tpu.utils import integrity
 
 
@@ -145,22 +146,26 @@ class SearchCheckpointer:
         return True
 
     def save(self, step: int, algorithm, backend) -> None:
-        search = {
-            "algorithm": algorithm.state_dict(),
-            "backend": backend.host_state_dict(),
-        }
-        items = {"search": ocp.args.JsonSave(search)}
-        tree_items = {}
-        pool = backend.device_state()
-        if pool is not None:
-            items["pool"] = ocp.args.StandardSave(pool)
-            tree_items["pool"] = pool
-        # verified save: per-item content digests ride inside the step
-        # (digesting a device pool costs one sync host fetch — the price
-        # of restore being able to prove the bytes survived)
-        manifest = integrity.build_manifest({"search": search}, tree_items)
-        items[integrity.MANIFEST_ITEM] = ocp.args.JsonSave(manifest)
-        self._mgr.save(step, args=ocp.args.Composite(**items))
+        # the save span bounds the HOST-side cost (state collection +
+        # digest + async enqueue); orbax's background write time shows
+        # up in close()'s save_wait span instead
+        with trace.span("save", step=step):
+            search = {
+                "algorithm": algorithm.state_dict(),
+                "backend": backend.host_state_dict(),
+            }
+            items = {"search": ocp.args.JsonSave(search)}
+            tree_items = {}
+            pool = backend.device_state()
+            if pool is not None:
+                items["pool"] = ocp.args.StandardSave(pool)
+                tree_items["pool"] = pool
+            # verified save: per-item content digests ride inside the step
+            # (digesting a device pool costs one sync host fetch — the price
+            # of restore being able to prove the bytes survived)
+            manifest = integrity.build_manifest({"search": search}, tree_items)
+            items[integrity.MANIFEST_ITEM] = ocp.args.JsonSave(manifest)
+            self._mgr.save(step, args=ocp.args.Composite(**items))
 
     # -- restore -----------------------------------------------------------
 
@@ -188,7 +193,8 @@ class SearchCheckpointer:
             has_manifest = integrity.MANIFEST_ITEM in names
             if has_manifest:
                 items[integrity.MANIFEST_ITEM] = ocp.args.JsonRestore()
-            r = self._mgr.restore(step, args=ocp.args.Composite(**items))
+            with trace.span("restore", step=step):
+                r = self._mgr.restore(step, args=ocp.args.Composite(**items))
             if has_manifest:
                 problems = integrity.verify_restored(
                     getattr(r, integrity.MANIFEST_ITEM),
@@ -229,7 +235,10 @@ class SearchCheckpointer:
     # -- lifecycle ---------------------------------------------------------
 
     def close(self) -> None:
-        self._mgr.wait_until_finished()
+        # save_wait: where the async saves' background write time
+        # surfaces on the host (the drain before the manager closes)
+        with trace.span("save_wait"):
+            self._mgr.wait_until_finished()
         self._mgr.close()
 
     def __enter__(self):
@@ -263,19 +272,20 @@ class SweepCheckpointer:
         )
 
     def save(self, step: int, sweep: dict, meta_extra: dict) -> None:
-        meta = {"config": self.config, **meta_extra}
-        # verified save: both items' content digests ride with the step
-        # (sweep arrays are host-fetched by every caller, so digesting
-        # costs hashing only, no extra device fetch)
-        manifest = integrity.build_manifest({"meta": meta}, {"sweep": sweep})
-        self._mgr.save(
-            step,
-            args=ocp.args.Composite(
-                sweep=ocp.args.StandardSave(sweep),
-                meta=ocp.args.JsonSave(meta),
-                **{integrity.MANIFEST_ITEM: ocp.args.JsonSave(manifest)},
-            ),
-        )
+        with trace.span("save", step=step):
+            meta = {"config": self.config, **meta_extra}
+            # verified save: both items' content digests ride with the step
+            # (sweep arrays are host-fetched by every caller, so digesting
+            # costs hashing only, no extra device fetch)
+            manifest = integrity.build_manifest({"meta": meta}, {"sweep": sweep})
+            self._mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    sweep=ocp.args.StandardSave(sweep),
+                    meta=ocp.args.JsonSave(meta),
+                    **{integrity.MANIFEST_ITEM: ocp.args.JsonSave(manifest)},
+                ),
+            )
 
     def restore(self):
         """(sweep_arrays, meta) from the newest VERIFIED snapshot, or
@@ -294,7 +304,8 @@ class SweepCheckpointer:
             has_manifest = integrity.MANIFEST_ITEM in names
             if has_manifest:
                 items[integrity.MANIFEST_ITEM] = ocp.args.JsonRestore()
-            r = self._mgr.restore(step, args=ocp.args.Composite(**items))
+            with trace.span("restore", step=step):
+                r = self._mgr.restore(step, args=ocp.args.Composite(**items))
             if has_manifest:
                 problems = integrity.verify_restored(
                     getattr(r, integrity.MANIFEST_ITEM),
@@ -357,7 +368,8 @@ class SweepCheckpointer:
         return r.sweep, r.meta
 
     def close(self) -> None:
-        self._mgr.wait_until_finished()
+        with trace.span("save_wait"):
+            self._mgr.wait_until_finished()
         self._mgr.close()
 
 

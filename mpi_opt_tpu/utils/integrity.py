@@ -210,12 +210,15 @@ def build_manifest(json_items: dict, tree_items: dict) -> dict:
     ``{"version", "items": {name: {"kind": "json"|"tree", "digest"}}}``.
     A ``digest`` of None marks an item unverifiable at save time
     (multi-host shards); verify skips it rather than failing."""
-    items = {}
-    for name, val in json_items.items():
-        items[name] = {"kind": "json", "digest": json_digest(val)}
-    for name, val in tree_items.items():
-        items[name] = {"kind": "tree", "digest": tree_digest(val)}
-    return {"version": MANIFEST_VERSION, "items": items}
+    from mpi_opt_tpu.obs import trace
+
+    with trace.span("digest", op="build", items=len(json_items) + len(tree_items)):
+        items = {}
+        for name, val in json_items.items():
+            items[name] = {"kind": "json", "digest": json_digest(val)}
+        for name, val in tree_items.items():
+            items[name] = {"kind": "tree", "digest": tree_digest(val)}
+        return {"version": MANIFEST_VERSION, "items": items}
 
 
 def verify_restored(manifest: dict, json_items: dict, tree_items: dict) -> list:
@@ -223,29 +226,34 @@ def verify_restored(manifest: dict, json_items: dict, tree_items: dict) -> list:
     returns human-readable problems (empty = verified). Items the
     manifest lists but the caller didn't restore are problems too — a
     vanished item is exactly the torn-save shape."""
+    from mpi_opt_tpu.obs import trace
+
     problems = []
     recorded = manifest.get("items", {})
     restored = {**json_items, **tree_items}
-    for name, entry in recorded.items():
-        want = entry.get("digest")
-        if want is None:
-            continue  # unverifiable at save time (multi-host shard)
-        if name not in restored:
-            problems.append(f"item {name!r}: recorded in manifest but not restored")
-            continue
-        got = (
-            json_digest(restored[name])
-            if entry.get("kind") == "json"
-            else tree_digest(restored[name])
-        )
-        if got != want:
-            problems.append(
-                f"item {name!r}: content digest mismatch "
-                f"(saved {want[:12]}..., restored {(got or 'unverifiable')[:12]}...)"
+    with trace.span("digest", op="verify", items=len(recorded)):
+        for name, entry in recorded.items():
+            want = entry.get("digest")
+            if want is None:
+                continue  # unverifiable at save time (multi-host shard)
+            if name not in restored:
+                problems.append(
+                    f"item {name!r}: recorded in manifest but not restored"
+                )
+                continue
+            got = (
+                json_digest(restored[name])
+                if entry.get("kind") == "json"
+                else tree_digest(restored[name])
             )
-    for name in restored:
-        if name not in recorded:
-            problems.append(f"item {name!r}: present but not in manifest")
+            if got != want:
+                problems.append(
+                    f"item {name!r}: content digest mismatch "
+                    f"(saved {want[:12]}..., restored {(got or 'unverifiable')[:12]}...)"
+                )
+        for name in restored:
+            if name not in recorded:
+                problems.append(f"item {name!r}: present but not in manifest")
     return problems
 
 

@@ -14,8 +14,15 @@ from typing import IO, Optional
 
 class MetricsLogger:
     def __init__(self, path: Optional[str] = None, stream: Optional[IO] = None, n_chips: int = 1):
+        import threading
+
         self._file = open(path, "a") if path else None
         self._stream = stream
+        # records arrive from more than one thread once span tracing is
+        # wired (obs/trace.py: StagingEngine's transfer thread emits
+        # stage_out spans concurrently with the main loop) — serialize
+        # the sink writes so two records can never interleave mid-line
+        self._sink_lock = threading.Lock()
         self.n_chips = max(1, n_chips)
         self.t_start = time.perf_counter()
         self.trials_done = 0
@@ -80,11 +87,12 @@ class MetricsLogger:
         }
         if self._file or self._stream:  # null_logger: no sink, no json cost
             line = json.dumps(rec)
-            if self._file:
-                self._file.write(line + "\n")
-                self._file.flush()
-            if self._stream:
-                print(line, file=self._stream, flush=True)
+            with self._sink_lock:
+                if self._file:
+                    self._file.write(line + "\n")
+                    self._file.flush()
+                if self._stream:
+                    print(line, file=self._stream, flush=True)
         return rec
 
     def count_trials(self, n: int):

@@ -13,6 +13,7 @@
 #        T1_SKIP_FSCK_DRILL=1 probes/tier1.sh # skip the fsck drill
 #        T1_SKIP_FUSED_LEDGER_DRILL=1 probes/tier1.sh # skip the ledger drill
 #        T1_SKIP_SERVICE_DRILL=1 probes/tier1.sh # skip the sweep-service drill
+#        T1_SKIP_TRACE_DRILL=1 probes/tier1.sh # skip the span-trace drill
 set -o pipefail
 cd "$(dirname "$0")/.."
 T1_LOG="${T1_LOG:-/tmp/_t1.log}"
@@ -163,6 +164,45 @@ PYEOF
         echo "SERVICE_DRILL=pass"
     else
         echo "SERVICE_DRILL=FAIL"
+        rc=$(( rc == 0 ? 1 : rc ))
+    fi
+fi
+
+# -- span-trace drill (observability layer, obs/) --
+# Run a tiny fused sweep with tracing into a metrics stream, render it
+# with `trace --json`, and assert: compile + train + save spans present,
+# the attributed self-seconds sum sanely against the measured wall, and
+# time-to-first-trial is reported — the schema/behavior gate for the
+# phase-attribution pipeline end to end.
+if [ -z "$T1_SKIP_TRACE_DRILL" ]; then
+    tr_rc=0
+    TD=$(mktemp -d /tmp/_t1_trace.XXXXXX)
+    timeout -k 10 180 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        --workload fashion_mlp --algorithm pbt --fused --no-mesh \
+        --population 4 --generations 3 --steps-per-generation 2 --seed 0 \
+        --checkpoint-dir "$TD/ck" --metrics-file "$TD/m.jsonl" --trace \
+        >/dev/null 2>&1 || tr_rc=1
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        trace "$TD/m.jsonl" --json >"$TD/trace.json" 2>/dev/null || tr_rc=1
+    python - "$TD/trace.json" <<'PYEOF' || tr_rc=1
+import json, sys
+rep = json.load(open(sys.argv[1]))
+ph = rep["phases"]
+for need in ("compile", "train", "save"):
+    assert need in ph and ph[need]["count"] > 0, (need, sorted(ph))
+wall = rep["wall_s"]
+total = sum(p["self_s"] for p in ph.values())
+# attributed self-seconds must sum sanely against the measured wall
+# (single stream, no background thread here: a small epsilon only)
+assert 0 < total <= wall * 1.05 + 0.5, (total, wall)
+assert rep["coverage"] and rep["coverage"] > 0.3, rep["coverage"]
+assert rep["time_to_first_trial_s"] is not None, rep
+PYEOF
+    rm -rf "$TD"
+    if [ $tr_rc -eq 0 ]; then
+        echo "TRACE_DRILL=pass"
+    else
+        echo "TRACE_DRILL=FAIL"
         rc=$(( rc == 0 ? 1 : rc ))
     fi
 fi
