@@ -397,11 +397,19 @@ def bench_cpu_baseline(steps, seed, n_workers, cache_path="CPU_BASELINE.json",
         "pool_trials_per_sec": pool_tps,
         "provenance": provenance,
     }
+    # tmp+replace: a Ctrl-C mid-dump must not leave a torn cache
+    # file that every later bench run trips over (sweeplint
+    # atomic-write — the same idiom as service/spool status writes)
+    tmp = f"{cache_path}.tmp{os.getpid()}"
     try:
-        with open(cache_path, "w") as f:
+        with open(tmp, "w") as f:
             _json.dump(rec, f, indent=1)
+        os.replace(tmp, cache_path)
     except OSError as e:
         log(f"[bench] could not cache baseline: {e}")
+    finally:
+        if os.path.exists(tmp):  # failed mid-write: no orphan debris
+            os.unlink(tmp)
     return pool_tps
 
 

@@ -570,9 +570,19 @@ def main():
             pass
 
     def write_out():
+        # called after EVERY config so a crash keeps earlier rounds —
+        # which is exactly why the write must be atomic: dying inside
+        # json.dump would destroy the very records the incremental
+        # write exists to preserve (sweeplint atomic-write)
         records = [existing[k] for k in sorted(existing)]
-        with open(args.out, "w") as f:
-            json.dump(records, f, indent=1)
+        tmp = f"{args.out}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(records, f, indent=1)
+            os.replace(tmp, args.out)
+        finally:
+            if os.path.exists(tmp):  # failed mid-write: no orphan debris
+                os.unlink(tmp)
 
     import tempfile
 

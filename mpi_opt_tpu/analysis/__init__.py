@@ -1,0 +1,64 @@
+"""sweeplint: AST invariant checkers for the sweep engine's contracts.
+
+Eight PRs of review rounds accreted cross-cutting invariants — fsync-
+before-report / journal-before-snapshot ordering, rank-0-gated ledger
+writes, exit codes only from ``utils/exitcodes``, atomic tmp+rename
+status writes, drain exceptions that must propagate, PRNG-key split
+discipline, no host syncs in the fused hot path, the event/span name
+registry — that previously lived only in review memory and CHANGES.md
+prose. This package machine-checks them, so the multi-file refactors
+the ROADMAP plans next cannot silently regress them.
+
+Surface:
+
+- ``mpi_opt_tpu lint [PATHS] [--json] [--baseline FILE]`` (cli.py
+  dispatch -> :mod:`mpi_opt_tpu.analysis.cli`), exit 0/1;
+- inline suppressions: ``# sweeplint: disable=<id>[,<id>] -- reason``
+  on the finding line or the line above;
+- barrier annotations for the host-sync checker:
+  ``# sweeplint: barrier(reason)`` on a ``def`` line exempts that
+  function's DIRECT body (nested defs are judged on their own);
+- a committed baseline (``sweeplint-baseline.json``) for accepted
+  legacy findings, fingerprinted by (check, file, line content) so
+  line-number drift never invalidates it;
+- the tier-1 self-lint (tests/test_analysis.py) runs the whole suite
+  over the repo.
+"""
+
+from __future__ import annotations
+
+from mpi_opt_tpu.analysis.core import (  # noqa: F401
+    Checker,
+    FileContext,
+    Finding,
+    check_source,
+    iter_python_files,
+    run_paths,
+)
+
+
+def all_checkers():
+    """One fresh instance of every registered checker (stateless between
+    files by contract; a fresh set per run keeps that honest)."""
+    from mpi_opt_tpu.analysis.checkers_drain import DrainSwallowChecker
+    from mpi_opt_tpu.analysis.checkers_durability import (
+        AtomicWriteChecker,
+        JournalOrderChecker,
+        LedgerFsyncChecker,
+        LedgerGateChecker,
+    )
+    from mpi_opt_tpu.analysis.checkers_exit import ExitCodeChecker
+    from mpi_opt_tpu.analysis.checkers_jax import HostSyncChecker, KeyReuseChecker
+    from mpi_opt_tpu.analysis.checkers_registry import EventRegistryChecker
+
+    return [
+        ExitCodeChecker(),
+        JournalOrderChecker(),
+        LedgerGateChecker(),
+        AtomicWriteChecker(),
+        LedgerFsyncChecker(),
+        DrainSwallowChecker(),
+        KeyReuseChecker(),
+        HostSyncChecker(),
+        EventRegistryChecker(),
+    ]

@@ -1,0 +1,252 @@
+"""JAX-discipline checkers: PRNG key reuse, host syncs in the hot path.
+
+- **key-reuse** — the same key NAME fed to two ``jax.random`` consumers
+  with no intervening rebind. PR 4's wave/resident bit-identity proof
+  hinged on exact key-split discipline (``split(k_init, P)`` windows,
+  per-step split streams); a copy-pasted ``normal(key, ...)`` pair
+  correlates draws that every algorithm assumes independent, and
+  nothing crashes — the search just quietly degrades. The checker is
+  deliberately linear and local: each straight-line statement list is
+  judged on its own (no cross-branch joins), so an if/else that each
+  consume a key once never false-positives.
+- **host-sync** — ``.item()`` / ``np.asarray`` / ``.block_until_ready``
+  / ``jax.device_get`` inside the fused hot-path modules
+  (``train/fused_*.py``, ``train/staging.py``) force a device
+  round-trip and serialize the dispatch pipeline; PERF_NOTES attributes
+  real plateau time to exactly such accidental syncs. Boundary code
+  MUST sync (scores must reach the host to exploit/journal) — those
+  functions carry an explicit ``# sweeplint: barrier(reason)`` on their
+  ``def`` line, which exempts the function's DIRECT body; nested defs
+  are judged on their own, so a traced program builder nested inside an
+  annotated host loop stays protected.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from mpi_opt_tpu.analysis.core import Checker, FileContext
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# -- key-reuse -----------------------------------------------------------
+
+#: jax.random callables whose FIRST argument is consumed key material.
+#: fold_in/key_data/wrap_key_data/PRNGKey/key are deliberately absent:
+#: fold_in DERIVES (reusing the base key with different data is the
+#: idiom), the others convert representations.
+_KEY_CONSUMERS = frozenset(
+    {
+        "split",
+        "normal",
+        "uniform",
+        "bernoulli",
+        "randint",
+        "permutation",
+        "categorical",
+        "choice",
+        "gumbel",
+        "exponential",
+        "truncated_normal",
+        "dirichlet",
+        "beta",
+        "gamma",
+        "poisson",
+        "laplace",
+        "cauchy",
+        "logistic",
+        "rademacher",
+        "shuffle",
+        "bits",
+    }
+)
+
+#: module spellings that mean jax.random at a call site
+_RANDOM_BASES = frozenset({"random", "jrandom", "jr"})
+#: bases whose `.random` is NOT jax's (numpy's legacy global RNG)
+_NOT_JAX = frozenset({"np", "numpy"})
+
+
+def _consumed_key(call: ast.Call):
+    """The key variable name a jax.random consumer call consumes, else
+    None. Conservative: only ``<random-module>.<consumer>(<Name>, ...)``
+    shapes count — a computed or attribute key expression is skipped
+    rather than guessed about."""
+    fn = call.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr in _KEY_CONSUMERS):
+        return None
+    base = fn.value
+    if isinstance(base, ast.Name):
+        if base.id not in _RANDOM_BASES:
+            return None
+    elif isinstance(base, ast.Attribute):
+        if base.attr != "random":
+            return None
+        if isinstance(base.value, ast.Name) and base.value.id in _NOT_JAX:
+            return None
+    else:
+        return None
+    if call.args and isinstance(call.args[0], ast.Name):
+        return call.args[0].id
+    return None
+
+
+def _bound_names(stmt):
+    """Names (re)bound by one statement: assignment targets, for/with
+    bindings, walrus — anything that makes a key name FRESH again."""
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign, ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        targets = [i.optional_vars for i in stmt.items if i.optional_vars]
+    out = set()
+    for t in targets:
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Name):
+                out.add(sub.id)
+    # walrus anywhere in the statement's expressions
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.NamedExpr) and isinstance(sub.target, ast.Name):
+            out.add(sub.target.id)
+    return out
+
+
+def _stmt_consumers(stmt):
+    """Consumer calls in ``stmt``'s OWN expressions, in source order —
+    not in nested statements (an if/else's arms are separate regions;
+    counting both arms here would false-positive a perfectly balanced
+    branch pair) and not in nested function defs."""
+    found = []
+    stack = list(ast.iter_child_nodes(stmt))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.stmt, ast.Lambda)):
+            continue  # nested statements are their own region
+        if isinstance(node, ast.Call):
+            name = _consumed_key(node)
+            if name is not None:
+                found.append((node.lineno, node.col_offset, name, node))
+        stack.extend(ast.iter_child_nodes(node))
+    return sorted(found, key=lambda t: (t[0], t[1]))
+
+
+class KeyReuseChecker(Checker):
+    id = "key-reuse"
+    hint = (
+        "split the key first (k1, k2 = jax.random.split(key)) and give "
+        "each consumer its own stream"
+    )
+    interests = _FUNC_NODES + (ast.Module,)
+
+    def visit(self, node, ctx: FileContext) -> None:
+        # every statement LIST in this scope is one straight-line region
+        # (if/else arms, loop bodies, try blocks each stand alone);
+        # nested function defs are their own visit.
+        for region in self._regions(node):
+            consumed: dict = {}
+            for stmt in region:
+                for lineno, _col, name, _call in _stmt_consumers(stmt):
+                    if name in consumed:
+                        self.report(
+                            ctx,
+                            lineno,
+                            f"PRNG key {name!r} consumed again (first use "
+                            f"line {consumed[name]}) with no intervening "
+                            "split/rebind — correlated draws",
+                        )
+                    else:
+                        consumed[name] = lineno
+                for name in _bound_names(stmt):
+                    consumed.pop(name, None)
+
+    def _regions(self, scope):
+        stack = [scope]
+        while stack:
+            node = stack.pop()
+            for fieldname in ("body", "orelse", "finalbody"):
+                body = getattr(node, fieldname, None)
+                if isinstance(body, list) and body:
+                    yield body
+                    for ch in body:
+                        if not isinstance(ch, (*_FUNC_NODES, ast.Lambda)):
+                            stack.append(ch)
+            for handler in getattr(node, "handlers", ()) or ():
+                yield handler.body
+                for ch in handler.body:
+                    if not isinstance(ch, (*_FUNC_NODES, ast.Lambda)):
+                        stack.append(ch)
+
+
+# -- host-sync -----------------------------------------------------------
+
+_SYNC_ATTRS = frozenset({"item", "block_until_ready"})
+
+
+def _sync_kind(call: ast.Call):
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in _SYNC_ATTRS and not call.args:
+            return f".{fn.attr}()"
+        if fn.attr == "asarray" and isinstance(fn.value, ast.Name):
+            if fn.value.id in ("np", "numpy"):
+                return "np.asarray"
+        if fn.attr == "device_get":
+            return "jax.device_get"
+    elif isinstance(fn, ast.Name) and fn.id == "device_get":
+        return "device_get"
+    return None
+
+
+class HostSyncChecker(Checker):
+    id = "host-sync"
+    severity = "error"
+    hint = (
+        "hot-path code must stay async; if this IS boundary/host code, "
+        "annotate the def line: # sweeplint: barrier(reason)"
+    )
+    interests = _FUNC_NODES + (ast.Module,)
+
+    def interested(self, ctx: FileContext) -> bool:
+        p = ctx.path.replace("\\", "/")
+        name = p.rsplit("/", 1)[-1]
+        return "train/" in p and (
+            name.startswith("fused_") or name == "staging.py"
+        )
+
+    def _annotated_barrier(self, fn, ctx: FileContext) -> bool:
+        start = fn.lineno
+        for dec in getattr(fn, "decorator_list", ()):
+            start = min(start, dec.lineno)
+        end = fn.body[0].lineno if fn.body else fn.lineno
+        return any(ln in ctx.barriers for ln in range(start, end + 1))
+
+    def visit(self, node, ctx: FileContext) -> None:
+        if isinstance(node, _FUNC_NODES) and self._annotated_barrier(node, ctx):
+            return
+        for call in self._direct_calls(node):
+            kind = _sync_kind(call)
+            if kind is None:
+                continue
+            # line-level barrier: one-off sync lines can be annotated
+            # without exempting the whole function
+            if call.lineno in ctx.barriers or call.lineno - 1 in ctx.barriers:
+                continue
+            self.report(
+                ctx,
+                call,
+                f"{kind} forces a host sync in a fused hot-path module — "
+                "only annotated barrier functions may block on the device",
+            )
+
+    @staticmethod
+    def _direct_calls(scope):
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (*_FUNC_NODES, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))

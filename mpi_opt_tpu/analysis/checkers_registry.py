@@ -1,0 +1,84 @@
+"""event-registry: every literal event/span name is registered.
+
+This is PR 8's ``obs/events.py`` call-site scanner migrated into the
+framework (satellite: the registry TABLES stay in obs/events.py — they
+are the metrics-stream schema's home and what a schema change must
+diff — while the AST mechanics live here with the other checkers;
+``obs.events.scan_call_sites``/``lint`` remain as thin shims so the
+historical tier-1 registry-lint surface keeps working).
+
+Emitter shapes gated (same rules as the original scanner):
+
+- kind "event": ``*.log("name", ...)`` (attribute call only — bench.py's
+  bare ``log(msg)`` stderr helper is not an emitter),
+  ``notify("name", ...)`` in both spellings, ``_event("name", ...)``;
+- kind "span": ``span("name", ...)`` / ``trace.span(...)`` /
+  ``@traced("name")``.
+
+Non-literal first arguments are skipped (re-emission helpers forward a
+variable on purpose).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from mpi_opt_tpu.analysis.core import Checker, FileContext
+
+
+def callee_kind(fn) -> str:
+    """"event"/"span"/"" for a call's func node (the one home for the
+    emitter-shape convention; obs.events re-exports it)."""
+    if isinstance(fn, ast.Attribute):
+        name, is_attr = fn.attr, True
+    elif isinstance(fn, ast.Name):
+        name, is_attr = fn.id, False
+    else:
+        return ""
+    if name == "log" and is_attr:
+        return "event"
+    if name in ("notify", "_event"):
+        return "event"
+    if name in ("span", "traced"):
+        return "span"
+    return ""
+
+
+def call_site(node: ast.Call):
+    """(kind, name) when ``node`` is a registered-emitter call with a
+    literal first argument, else None."""
+    if not node.args:
+        return None
+    first = node.args[0]
+    if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+        return None
+    kind = callee_kind(node.func)
+    return (kind, first.value) if kind else None
+
+
+class EventRegistryChecker(Checker):
+    id = "event-registry"
+    hint = "register the name in obs/events.py (EVENTS or SPANS)"
+    interests = (ast.Call,)
+
+    def __init__(self):
+        super().__init__()
+        # imported lazily-late so the checker module stays importable
+        # even while obs/ is being refactored under it
+        from mpi_opt_tpu.obs.events import EVENTS, SPANS
+
+        self._tables = {"event": EVENTS, "span": SPANS}
+
+    def visit(self, node, ctx: FileContext) -> None:
+        site = call_site(node)
+        if site is None:
+            return
+        kind, name = site
+        if name not in self._tables[kind]:
+            table = "EVENTS" if kind == "event" else "SPANS"
+            self.report(
+                ctx,
+                node,
+                f"unregistered {kind} name {name!r} — add it to "
+                f"obs/events.py {table}",
+            )

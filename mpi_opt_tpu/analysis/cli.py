@@ -1,0 +1,153 @@
+"""``mpi_opt_tpu lint [PATHS] [--json] [--baseline FILE]``.
+
+Dispatched from cli.py like ``report``/``fsck``/``trace``; never
+touches jax. Exit 0 = no non-baselined findings (and no unparseable
+files), 1 = findings (or scan errors), 2 = usage.
+
+The JSON schema mirrors the ``fsck``/``report --validate`` pattern —
+one stable top-level object a CI gate can parse::
+
+    {"ok": bool, "tool": "sweeplint", "files_scanned": N,
+     "findings": [{"check", "file", "line", "severity", "message",
+                   "hint"}, ...],
+     "baselined": [...same shape...], "errors": [str, ...],
+     "checks": [{"id", "severity", "hint"}, ...]}
+
+``--write-baseline FILE`` records the CURRENT findings as accepted —
+the adoption workflow: run it once on a legacy tree, commit the file,
+and the gate only fails on NEW findings from then on. (This repo's
+committed ``sweeplint-baseline.json`` is empty by policy: ISSUE 9 fixed
+every true positive and marked deliberate cases inline with
+``# sweeplint: disable`` — the baseline exists so the NEXT big refactor
+can stage fixes without turning the gate off.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from mpi_opt_tpu.analysis import all_checkers
+from mpi_opt_tpu.analysis.core import (
+    load_baseline,
+    run_paths,
+    split_baselined,
+    write_baseline,
+)
+from mpi_opt_tpu.utils.exitcodes import EX_FAILURE, EX_OK
+
+
+def repo_root() -> str:
+    """Default scan root: the directory HOLDING the mpi_opt_tpu package
+    (the repo checkout in every supported layout), so bare
+    ``mpi_opt_tpu lint`` covers package + top-level scripts (bench.py,
+    launch entry) exactly like the tier-1 self-lint."""
+    import mpi_opt_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(mpi_opt_tpu.__file__)))
+
+
+def lint_main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="mpi_opt_tpu lint",
+        description="AST invariant checks for the sweep engine's "
+        "contracts (see README: Static analysis)",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        metavar="PATH",
+        help="files/directories to lint (default: the repo root; "
+        "tests/ and probes/ are always excluded)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable report on stdout"
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        metavar="FILE",
+        help="accepted-legacy-findings file: findings fingerprinted "
+        "there are reported separately and never fail the run",
+    )
+    p.add_argument(
+        "--write-baseline",
+        default=None,
+        metavar="FILE",
+        help="record the current findings as the accepted baseline "
+        "and exit 0 (the adoption workflow)",
+    )
+    args = p.parse_args(argv)
+
+    root = repo_root()
+    paths = args.paths or [root]
+    for path in paths:
+        if not os.path.exists(path):
+            p.error(f"{path!r} does not exist")
+    baseline = []
+    if args.baseline is not None:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            p.error(f"--baseline: {e}")
+
+    checkers = all_checkers()
+    findings, n_files, errors = run_paths(paths, checkers)
+    fresh, accepted = split_baselined(findings, baseline, root)
+
+    if args.write_baseline is not None:
+        if errors:
+            # a baseline recorded while files are unparseable is a lie:
+            # every finding in those files would later surface as "new"
+            # (or ship unrecorded) — refuse, same no-silent-skips rule
+            # as the lint itself
+            for e in errors:
+                print(f"scan error: {e}", file=sys.stderr)
+            print(
+                f"refusing to write a baseline over {len(errors)} "
+                "unparseable file(s) — fix them and re-run",
+                file=sys.stderr,
+            )
+            return EX_FAILURE
+        write_baseline(args.write_baseline, findings, root)
+        print(
+            f"wrote {len(findings)} accepted finding(s) to "
+            f"{args.write_baseline}",
+            file=sys.stderr,
+        )
+        return EX_OK
+
+    ok = not fresh and not errors
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "ok": ok,
+                    "tool": "sweeplint",
+                    "files_scanned": n_files,
+                    "findings": [f.as_dict(root) for f in fresh],
+                    "baselined": [f.as_dict(root) for f in accepted],
+                    "errors": errors,
+                    "checks": [
+                        {"id": c.id, "severity": c.severity, "hint": c.hint}
+                        for c in checkers
+                    ],
+                }
+            )
+        )
+    else:
+        for f in fresh:
+            print(f.render(root))
+        for f in accepted:
+            print(f"{f.render(root)} [baselined]")
+        for e in errors:
+            print(f"scan error: {e}", file=sys.stderr)
+        tail = f"{n_files} file(s), {len(fresh)} finding(s)"
+        if accepted:
+            tail += f", {len(accepted)} baselined"
+        if errors:
+            tail += f", {len(errors)} unparseable"
+        print(("OK: " if ok else "FAIL: ") + tail, file=sys.stderr)
+    return EX_OK if ok else EX_FAILURE

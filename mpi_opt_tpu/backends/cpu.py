@@ -183,6 +183,7 @@ def _stateful_worker_main(conn, workload_name, workload_kwargs, seed, max_states
     deadline."""
     try:
         _init_pool_worker(workload_name, workload_kwargs)
+    # sweeplint: disable=drain-swallow -- spawned worker: no drain protocol here; init failure is reported to the parent over the pipe and the worker exits
     except BaseException as e:
         try:
             conn.send(("init_failed", f"{type(e).__name__}: {e}"))

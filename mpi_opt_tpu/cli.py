@@ -990,6 +990,12 @@ def main(argv=None, *, _workload=None) -> int:
         from mpi_opt_tpu.utils.integrity import fsck_main
 
         return fsck_main(argv[1:])
+    # `mpi_opt_tpu lint [PATHS]` machine-checks the engine's invariants
+    # (analysis/ sweeplint suite); never touches jax
+    if argv and argv[0] == "lint":
+        from mpi_opt_tpu.analysis.cli import lint_main
+
+        return lint_main(argv[1:])
     # `mpi_opt_tpu trace FILE|DIR` renders phase-time attribution over
     # JSONL metrics streams (obs/report.py); never touches jax
     if argv and argv[0] == "trace":

@@ -15,7 +15,6 @@ here, which is the point: the schema change becomes a reviewed diff.
 from __future__ import annotations
 
 import ast
-import os
 
 #: every legal ``event`` value in the JSONL metrics stream (including
 #: launch.py's supervisor events and utils/integrity.py observer
@@ -95,76 +94,46 @@ def is_span(name: str) -> bool:
     return name in SPANS
 
 
-def _callee_kind(fn) -> str:
-    """"event"/"span"/"" for a call's func node. ``log`` counts only as
-    an ATTRIBUTE call (``metrics.log``) — bench.py's bare ``log(msg)``
-    stderr helper is not an event emitter; ``notify``/``span``/``traced``
-    count in both spellings; ``_event`` is launch.py's bare helper."""
-    if isinstance(fn, ast.Attribute):
-        name, is_attr = fn.attr, True
-    elif isinstance(fn, ast.Name):
-        name, is_attr = fn.id, False
-    else:
-        return ""
-    if name == "log" and is_attr:
-        return "event"
-    if name in ("notify", "_event"):
-        return "event"
-    if name in ("span", "traced"):
-        return "span"
-    return ""
+# -- scanner shims (ISSUE 9) ---------------------------------------------
+#
+# The AST call-site scanner that used to live here was generalized into
+# the sweeplint framework (analysis/checkers_registry.EventRegistryChecker
+# — one shared parse per file, same suppression/baseline machinery as
+# every other invariant). The TABLES above stay here: they are the
+# metrics-stream schema's home and what a schema change must diff. These
+# shims keep the historical surface (tests/test_obs.py's registry lint,
+# outside tooling) working unchanged.
 
 
 def scan_call_sites(root: str):
-    """Walk ``root`` for Python files (tests excluded — they fabricate
-    names on purpose) and yield ``(path, lineno, kind, name)`` for every
-    call site whose first argument is a string literal and whose callee
-    is one of the registered emitters:
+    """Yield ``(path, lineno, kind, name)`` for every registered-emitter
+    call site with a literal first argument under ``root`` (tests and
+    probes excluded — they fabricate names on purpose). Thin shim over
+    :mod:`mpi_opt_tpu.analysis.checkers_registry`; see its docstring for
+    the emitter shapes gated."""
+    from mpi_opt_tpu.analysis.checkers_registry import call_site
+    from mpi_opt_tpu.analysis.core import iter_python_files
 
-    - kind ``"event"``: ``*.log("name", ...)``, ``notify("name", ...)``,
-      ``*._event(...)`` / ``_event("name", ...)``;
-    - kind ``"span"``: ``span("name", ...)`` / ``trace.span(...)`` /
-      ``@traced("name")``.
-
-    Non-literal first arguments are skipped (re-emission helpers like
-    the integrity observer forward a variable). The tier-1 registry
-    lint (tests/test_obs.py) is the one consumer.
-    """
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [
-            d
-            for d in dirnames
-            if d not in ("__pycache__", ".git", "tests", "probes", "node_modules")
-        ]
-        for fname in filenames:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            try:
-                with open(path) as f:
-                    tree = ast.parse(f.read())
-            except (OSError, SyntaxError):
-                continue
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call) or not node.args:
-                    continue
-                first = node.args[0]
-                if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
-                    continue
-                kind = _callee_kind(node.func)
-                if kind:
-                    yield path, node.lineno, kind, first.value
+    for path in iter_python_files(root):
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                site = call_site(node)
+                if site is not None:
+                    yield path, node.lineno, site[0], site[1]
 
 
 def lint(root: str) -> list:
     """Human-readable problems for unregistered names under ``root``
-    (empty = clean). The tier-1 gate wraps this."""
-    problems = []
-    for path, lineno, kind, name in scan_call_sites(root):
-        table = EVENTS if kind == "event" else SPANS
-        if name not in table:
-            problems.append(
-                f"{path}:{lineno}: unregistered {kind} name {name!r} — "
-                f"add it to obs/events.py {'EVENTS' if kind == 'event' else 'SPANS'}"
-            )
-    return problems
+    (empty = clean). Shim over the ``event-registry`` sweeplint checker
+    — the same check `mpi_opt_tpu lint` runs; the tier-1 gate wraps
+    this."""
+    from mpi_opt_tpu.analysis.checkers_registry import EventRegistryChecker
+    from mpi_opt_tpu.analysis.core import run_paths
+
+    findings, _n, errors = run_paths([root], [EventRegistryChecker()])
+    return [f"{f.file}:{f.line}: {f.message}" for f in findings] + list(errors)

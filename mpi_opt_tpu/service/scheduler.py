@@ -439,6 +439,7 @@ class SweepService:
                             rc = 1
                     except KeyboardInterrupt:
                         raise
+                    # sweeplint: disable=drain-swallow -- tenant-slice containment: one tenant's escaped error terminal-fails the slice (rc=1 in run.log), it must not kill the resident server; cli.main maps SweepInterrupted to exit 75 before it could reach here
                     except BaseException:
                         logf.write(traceback.format_exc())
                         rc = 1

@@ -74,7 +74,7 @@ def sha_cohort_sizes(n_trials: int, n_rungs: int, eta: int, round_to: int = 1) -
     return sizes
 
 
-def fused_sha(
+def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for the rung cut + journal)
     workload,
     n_trials: int,
     min_budget: int = 10,
@@ -401,7 +401,7 @@ def fused_sha(
     }
 
 
-def _bracket_cohort(checkpoint_dir, b: int, n: int, tag: str, cohort_fn):
+def _bracket_cohort(checkpoint_dir, b: int, n: int, tag: str, cohort_fn):  # sweeplint: barrier(bracket cohort cache: materializes suggested units to disk)
     """Sample bracket ``b``'s initial cohort — durably, when the sweep
     is checkpointed. The sampled matrix is persisted next to the
     bracket snapshots and REUSED on resume: regenerating it would

@@ -36,7 +36,7 @@ from mpi_opt_tpu.train.common import workload_arrays
 from mpi_opt_tpu.train.fused_asha import fused_hyperband
 
 
-def fused_bohb(
+def fused_bohb(  # sweeplint: barrier(bracket host loop: files rung observations into the host-side ObsStore)
     workload,
     max_budget: int = 270,
     eta: int = 3,
@@ -71,7 +71,7 @@ def fused_bohb(
                 obs.add(int(o.budget), np.asarray(o.unit), float(o.score))
     suggest = jax.jit(tpe_suggest, static_argnames=("n_suggest", "cfg"))
 
-    def cohort_fn(b: int, n: int):
+    def cohort_fn(b: int, n: int):  # sweeplint: barrier(per-bracket re-suggest: the TPE acquisition completes on host by design)
         """(initial unit matrix, model-drawn count) for bracket b: model
         draws where a budget qualifies, uniform for the random fraction
         (and always before any budget qualifies)."""

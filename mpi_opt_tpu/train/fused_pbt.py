@@ -274,7 +274,7 @@ def _writable(tree):
     )
 
 
-def _fused_pbt_waves(
+def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gathers scores, exploits at generation boundaries)
     workload,
     trainer,
     space,
@@ -439,7 +439,7 @@ def _fused_pbt_waves(
     flops_gen = segment_flops_hint(workload, population, steps_per_gen)
 
     def _writer(off):
-        def on_host(host):
+        def on_host(host):  # sweeplint: barrier(stage-out landing: writes fetched wave scores into the host pool)
             write_rows(pool_back, off, host["state"])
             w = len(host["scores"])
             scores_host[off : off + w] = np.asarray(host["scores"], np.float32)
@@ -517,7 +517,7 @@ def _fused_pbt_waves(
                         _writer(off),
                     )
 
-                    def save_midgen(g=g, w=w):
+                    def save_midgen(g=g, w=w):  # sweeplint: barrier(between-waves drain snapshot: fetches partial state for the checkpoint)
                         engine.drain()  # pools must hold every completed wave
                         # COPY the pools: orbax's save is async, and the live
                         # buffers are mutated in place by later waves' stage-out
@@ -606,7 +606,7 @@ def _fused_pbt_waves(
             is_last = g + 1 == generations
             due = (g + 1) % snapshot_every == 0
 
-            def save_boundary(g=g):
+            def save_boundary(g=g):  # sweeplint: barrier(generation-boundary snapshot: fetches pool + perm for the checkpoint)
                 # COPY the pool: the async orbax write may still be in
                 # flight when this buffer (pool_back after the swap) is
                 # mutated in place by a LATER generation's stage-out
@@ -734,7 +734,7 @@ def _run_stepped_generation(
     )
 
 
-def fused_pbt(
+def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, exploit, journal, snapshot)
     workload,
     population: int,
     generations: int,

@@ -85,7 +85,7 @@ def tpe_generation(
     return obs_unit, obs_scores, valid, key, scores, sugg
 
 
-def fused_tpe(
+def fused_tpe(  # sweeplint: barrier(batch host loop: fetches obs ring for snapshot/journal at batch boundaries)
     workload,
     n_trials: int,
     batch: int = 32,

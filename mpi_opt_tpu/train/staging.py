@@ -146,7 +146,7 @@ class StagingEngine:
 
     # -- worker ----------------------------------------------------------
 
-    def _loop(self):
+    def _loop(self):  # sweeplint: barrier(the transfer thread IS the barrier: its whole job is host<->device copies)
         from mpi_opt_tpu.health import heartbeat
         from mpi_opt_tpu.obs import trace
 
@@ -185,6 +185,7 @@ class StagingEngine:
                     # (heartbeat.beat is thread-safe; no-op when the CLI
                     # configured no heartbeat file)
                     heartbeat.beat(stage="staging transfer", transfers=n)
+            # sweeplint: disable=drain-swallow -- transfer-thread containment: the error is stored and re-raised to the main thread by drain()
             except BaseException as e:  # surfaced by drain()
                 with self._lock:
                     self._errors.append(e)

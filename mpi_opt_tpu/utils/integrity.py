@@ -665,6 +665,7 @@ def fsck_main(argv=None) -> int:
                 torn_tail, torn_boundary = False, None
             if (torn_tail or torn_boundary is not None) and args.repair:
                 try:
+                    # sweeplint: disable=ledger-gate -- fsck --repair is a single-process operator tool; the load-time truncation IS the repair, no SPMD rank can race it
                     SweepLedger(ledger_path).close()  # load truncates in place
                 except LedgerError:
                     pass  # damage beyond the append-kill shapes: report only

@@ -14,6 +14,7 @@
 #        T1_SKIP_FUSED_LEDGER_DRILL=1 probes/tier1.sh # skip the ledger drill
 #        T1_SKIP_SERVICE_DRILL=1 probes/tier1.sh # skip the sweep-service drill
 #        T1_SKIP_TRACE_DRILL=1 probes/tier1.sh # skip the span-trace drill
+#        T1_SKIP_LINT_DRILL=1 probes/tier1.sh # skip the sweeplint drill
 set -o pipefail
 cd "$(dirname "$0")/.."
 T1_LOG="${T1_LOG:-/tmp/_t1.log}"
@@ -203,6 +204,35 @@ PYEOF
         echo "TRACE_DRILL=pass"
     else
         echo "TRACE_DRILL=FAIL"
+        rc=$(( rc == 0 ? 1 : rc ))
+    fi
+fi
+
+# -- sweeplint drill (static-analysis layer, analysis/) --
+# The full invariant-checker suite over the repo at HEAD: exit 0 and
+# ZERO non-baselined findings (the committed baseline is empty by
+# policy — true positives are fixed, deliberate cases carry inline
+# `# sweeplint: disable` reasons), with the JSON schema the CI gate
+# parses. A finding here means a refactor regressed one of the
+# machine-checked contracts (see README: Static analysis).
+if [ -z "$T1_SKIP_LINT_DRILL" ]; then
+    lint_rc=0
+    LJ=$(mktemp /tmp/_t1_lint.XXXXXX.json)
+    timeout -k 10 120 python -m mpi_opt_tpu \
+        lint --json --baseline sweeplint-baseline.json >"$LJ" 2>/dev/null \
+        || lint_rc=1
+    python - "$LJ" <<'PYEOF' || lint_rc=1
+import json, sys
+rep = json.load(open(sys.argv[1]))
+assert rep["ok"] is True, rep["findings"] or rep["errors"]
+assert rep["tool"] == "sweeplint" and rep["findings"] == [], rep
+assert rep["files_scanned"] > 50, rep["files_scanned"]  # scan saw the tree
+PYEOF
+    rm -f "$LJ"
+    if [ $lint_rc -eq 0 ]; then
+        echo "LINT_DRILL=pass"
+    else
+        echo "LINT_DRILL=FAIL"
         rc=$(( rc == 0 ? 1 : rc ))
     fi
 fi

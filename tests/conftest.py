@@ -59,6 +59,35 @@ if os.environ.get("MPI_OPT_TPU_TEST_CACHE") == "1":
 PER_WORKER_TEST_BUDGET = 120
 
 
+# -- runtime sanitizers (ISSUE 9; tests/sanitizers.py) --------------------
+#
+# Every test is followed by a leak check over process-global state:
+# non-daemon threads, SIGTERM/SIGINT dispositions, the trace sink,
+# heartbeat, integrity observer, shutdown guard + slice hook. Snapshot-
+# based (only state THIS test added fails it) so an accepted leak never
+# cascades. Opt out with @pytest.mark.leaks_ok for drills that leave
+# state on purpose.
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _runtime_sanitizers(request):
+    import sanitizers  # tests/ is on sys.path via pytest's conftest rule
+
+    before = sanitizers.snapshot()
+    yield
+    if request.node.get_closest_marker("leaks_ok") is not None:
+        return
+    problems = sanitizers.leaks(before)
+    if problems:
+        pytest.fail(
+            "runtime sanitizers: leaked process-global state:\n  - "
+            + "\n  - ".join(problems),
+            pytrace=False,
+        )
+
+
 def pytest_collection_finish(session):
     config = session.config
     n = len(session.items)

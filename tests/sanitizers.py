@@ -1,0 +1,130 @@
+"""Runtime sanitizers: per-test leak checks for process-global state.
+
+Three recurring review-round bug classes — a background thread left
+running, a signal handler left installed (the ShutdownGuard
+scope/restore contract), a metrics/trace/heartbeat sink left configured
+by an in-process CLI run — turn into hard test failures here instead of
+flaky cross-test contamination three files later. The check is
+snapshot-based: whatever global state a test STARTED with is the
+baseline (a prior test's accepted leak must not cascade-fail every
+test after it); only state the test itself added and failed to clean up
+fails it.
+
+Wired as an autouse fixture in tests/conftest.py. Opt out per test with
+``@pytest.mark.leaks_ok`` (registered in pytest.ini) for drills that
+intentionally leave state — e.g. SIGKILL-shaped subprocess kills whose
+in-process twin deliberately abandons a wedged worker thread.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+#: signals the ShutdownGuard contract covers (install-on-enter,
+#: restore-on-exit); SIGINT also guards against tests clobbering
+#: pytest's own KeyboardInterrupt handling
+_GUARDED_SIGNALS = ("SIGTERM", "SIGINT")
+
+#: grace given to teardown-in-flight threads (an orbax async-save or a
+#: pool shutdown may still be unwinding when the test body returns;
+#: joining briefly separates "slow teardown" from "leaked forever")
+_JOIN_GRACE_S = 2.0
+
+
+def _live_threads() -> dict:
+    return {t.ident: t for t in threading.enumerate() if t.is_alive()}
+
+
+def _handlers() -> dict:
+    return {
+        name: signal.getsignal(getattr(signal, name)) for name in _GUARDED_SIGNALS
+    }
+
+
+def snapshot() -> dict:
+    """The process-global state a test is allowed to return to."""
+    from mpi_opt_tpu.health import heartbeat, shutdown
+    from mpi_opt_tpu.obs import trace
+    from mpi_opt_tpu.utils import integrity
+
+    return {
+        "threads": set(_live_threads()),
+        "handlers": _handlers(),
+        "trace": trace.save(),
+        "heartbeat": heartbeat.active(),
+        "observer": integrity._OBSERVER,
+        "guard": shutdown._ACTIVE,
+        "slice_hook": shutdown._SLICE_HOOK,
+    }
+
+
+def leaks(before: dict) -> list:
+    """Human-readable leak descriptions vs the ``before`` snapshot
+    (empty = clean). Pure check — mutates nothing, so a failing test's
+    OWN exception stays the headline and the leak report rides along."""
+    from mpi_opt_tpu.health import heartbeat, shutdown
+    from mpi_opt_tpu.obs import trace
+    from mpi_opt_tpu.utils import integrity
+
+    problems = []
+
+    # -- non-daemon thread leaks (daemon threads die with the process
+    # and jax/tensorstore own long-lived internal ones; NON-daemon
+    # threads a test started and never joined hang the interpreter at
+    # exit and poison every later test's timing)
+    fresh = [
+        t
+        for ident, t in _live_threads().items()
+        if ident not in before["threads"] and not t.daemon
+    ]
+    deadline_each = _JOIN_GRACE_S / max(1, len(fresh))
+    for t in fresh:
+        t.join(deadline_each)
+        if t.is_alive():
+            problems.append(
+                f"leaked non-daemon thread {t.name!r} (still alive "
+                f"{_JOIN_GRACE_S:.0f}s after the test) — join/close it "
+                "(StagingEngine.close, backend.close, server shutdown)"
+            )
+
+    # -- signal-handler restore (the ShutdownGuard contract: handlers
+    # installed on enter are restored on exit, even on error paths)
+    for name, prev in before["handlers"].items():
+        now = signal.getsignal(getattr(signal, name))
+        if now is not prev and now != prev:
+            problems.append(
+                f"{name} handler changed across the test "
+                f"({prev!r} -> {now!r}) — a ShutdownGuard (or raw "
+                "signal.signal call) was not scoped/restored"
+            )
+
+    # -- process-global sinks (an in-process cli.main/serve run must
+    # deconfigure on every exit path; a leftover sink makes later tests
+    # emit into a dead logger's closed file)
+    if trace.save() != before["trace"]:
+        problems.append(
+            "trace sink left configured — obs.trace.deconfigure(prior) "
+            "missing on an exit path (cli.main's finally is the pattern)"
+        )
+    if heartbeat.active() is not before["heartbeat"]:
+        problems.append(
+            "heartbeat left configured — health.heartbeat.deconfigure() "
+            "missing on an exit path"
+        )
+    if integrity._OBSERVER is not before["observer"]:
+        problems.append(
+            "integrity observer left installed — "
+            "utils.integrity.clear_observer() missing on an exit path"
+        )
+    if shutdown._ACTIVE is not before["guard"]:
+        problems.append(
+            "ShutdownGuard left active — the guard's __exit__ never ran "
+            "(use `with ShutdownGuard():`, never enter it bare)"
+        )
+    if shutdown._SLICE_HOOK is not before["slice_hook"]:
+        problems.append(
+            "slice hook left installed — shutdown.clear_slice_hook() "
+            "missing on a scheduler exit path"
+        )
+    return problems
