@@ -119,6 +119,14 @@ def bench_tpu(args):
         trace_metrics.close()
         trace_rep = bench_attribution(trace_path)
         log(f"[bench] trace stream {trace_path}: coverage {trace_rep['coverage']}")
+    # device-memory watermark (obs/memory.py): sampled AFTER the
+    # measured run, while the sweep's state is still resident — the
+    # number the wave-size/bf16 planning needs measured, not derived
+    from mpi_opt_tpu.obs import memory as _obs_memory
+
+    device_memory = _obs_memory.watermark()
+    if device_memory is not None:
+        log(f"[bench] device memory: {device_memory}")
     trials = population * generations
     tps = trials / wall
     # flops accounting AFTER the timed window (it lowers/compiles tiny
@@ -158,6 +166,7 @@ def bench_tpu(args):
         "device": jax.devices()[0].device_kind,
         "trace": trace_rep,
         "trace_stream": trace_path if args.trace_file else None,
+        "device_memory": device_memory,
     }
 
 
@@ -450,8 +459,15 @@ def main():
     )
     args = p.parse_args()
 
+    from mpi_opt_tpu.obs.diff import BENCH_SCHEMA_VERSION
+
     tpu = bench_tpu(args)
     record = {
+        # versioned record shape: the BENCH_r0*.json drift gate
+        # (tests/test_bench_schema.py) and `trace --diff`'s trajectory
+        # loading both key on it — bump obs/diff.py BENCH_SCHEMA_VERSION
+        # when the shape changes, never drift silently
+        "schema_version": BENCH_SCHEMA_VERSION,
         "metric": "pbt_cifar10_cnn_member_generations_per_sec_per_chip",
         "value": round(tpu["tps"], 4),
         "unit": "trials/sec/chip",
@@ -476,6 +492,9 @@ def main():
         # None under --no-trace
         "trace": tpu["trace"],
         "trace_stream": tpu["trace_stream"],
+        # device-memory watermark (obs/memory.py): peak/steady HBM with
+        # its accounting source — None only in a jax-less environment
+        "device_memory": tpu["device_memory"],
     }
     if args.skip_baseline:
         record["vs_baseline"] = 1.0

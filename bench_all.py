@@ -45,9 +45,19 @@ def traced_config(fn, trace_dir, config_id: int):
     """Run one config under span tracing (obs/trace.py) and attach the
     phase-attribution JSON to its record — BENCH_r06+ carries a
     compile/train/save breakdown beside trials/s instead of one opaque
-    wall number. ``trace_dir=None`` runs untraced (--no-trace)."""
+    wall number. ``trace_dir=None`` runs untraced (--no-trace). Either
+    way the record leaves versioned (``schema_version``) and carrying
+    the device-memory watermark — the drift gate and the trajectory
+    diff both depend on the shape being declared, not inferred."""
+    from mpi_opt_tpu.obs import memory as obs_memory
+
+    # per-config watermark window: the live-array fallback's peak is a
+    # process-lifetime running max — without the reset, config 5's
+    # record would wear config 1's (possibly much larger) footprint
+    # forever in BENCH_ALL.json
+    obs_memory.reset_peak()
     if trace_dir is None:
-        return fn()
+        return _finish_record(fn())
     import os
 
     from mpi_opt_tpu.obs import trace as _trace
@@ -65,6 +75,21 @@ def traced_config(fn, trace_dir, config_id: int):
         metrics.close()
     rec["trace"] = bench_attribution(path)
     rec["trace_stream"] = path
+    return _finish_record(rec)
+
+
+def _finish_record(rec: dict) -> dict:
+    """Stamp the versioned-record fields every config record carries:
+    ``schema_version`` (obs/diff.py owns the number and the validator)
+    and the post-run ``device_memory`` watermark (obs/memory.py) —
+    sampled HERE, right after the config's sweeps, while its state is
+    still resident."""
+    from mpi_opt_tpu.obs import memory as obs_memory
+    from mpi_opt_tpu.obs.diff import BENCH_SCHEMA_VERSION
+
+    rec.setdefault("schema_version", BENCH_SCHEMA_VERSION)
+    rec.setdefault("trace", None)
+    rec.setdefault("device_memory", obs_memory.watermark())
     return rec
 
 
@@ -532,7 +557,49 @@ def main():
         help="measure without span tracing (drops the per-config phase "
         "breakdown from the records)",
     )
+    p.add_argument(
+        "--gate-base",
+        default=None,
+        metavar="PRIOR.json",
+        help="after measuring, judge the configs measured in THIS run "
+        "against a prior record set (a BENCH_ALL.json, or one "
+        "BENCH_r0*.json record) with obs/diff.py's bench_gate: "
+        "headline-value regressions plus per-phase trace regressions "
+        "where both sides embed attributions (stale records merged "
+        "from a prior --out are never judged). Prints one benchgate "
+        "JSON line and exits 1 on regression — the BENCH-trajectory "
+        "CI verdict",
+    )
+    p.add_argument(
+        "--gate-tol",
+        default=None,
+        metavar="TOL.json",
+        help="with --gate-base: tolerance budgets (same file format as "
+        "`trace --diff --gate`, plus value_max_rel_regression; default "
+        "budgets apply without it)",
+    )
     args = p.parse_args()
+    if args.gate_tol and not args.gate_base:
+        p.error("--gate-tol requires --gate-base")
+    gate_tol = None
+    if args.gate_tol:
+        from mpi_opt_tpu.obs.diff import validate_tolerances
+
+        try:
+            with open(args.gate_tol) as f:
+                gate_tol = json.load(f)
+            validate_tolerances(gate_tol)
+        except (OSError, ValueError) as e:
+            p.error(f"--gate-tol: {e}")
+    gate_base = None
+    if args.gate_base:
+        # load + shape-check BEFORE measuring: a typo'd prior path must
+        # not cost a bench run to discover
+        try:
+            with open(args.gate_base) as f:
+                gate_base = json.load(f)
+        except (OSError, ValueError) as e:
+            p.error(f"--gate-base: {e}")
 
     runners = {
         "1": lambda: bench_config1(args.seed),
@@ -601,7 +668,26 @@ def main():
         print(json.dumps(rec), flush=True)
         write_out()  # after EVERY config: a later crash loses nothing
     log(f"[bench_all] wrote {args.out}")
+    if gate_base is not None:
+        # the trajectory verdict: THIS run's measurements vs the prior
+        # round, machine-checked (obs/diff.py bench_gate) — rc 1 means a
+        # headline value or a gated trace phase regressed past budget.
+        # Only configs measured in this invocation are judged: `existing`
+        # also holds stale records merged from a prior --out file, and
+        # gating those would diff the prior round against itself and
+        # report an un-measured config as judged-clean
+        from mpi_opt_tpu.obs.diff import bench_gate
+
+        measured = [existing[int(c)] for c in wanted if int(c) in existing]
+        verdict = bench_gate(gate_base, measured, gate_tol)
+        print(json.dumps(verdict), flush=True)
+        if not verdict["ok"]:
+            for v in verdict["violations"]:
+                log(f"[bench_all] GATE: {v}")
+            return 1
+        log("[bench_all] gate: OK")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

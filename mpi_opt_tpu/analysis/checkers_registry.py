@@ -58,16 +58,17 @@ def call_site(node: ast.Call):
 
 class EventRegistryChecker(Checker):
     id = "event-registry"
-    hint = "register the name in obs/events.py (EVENTS or SPANS)"
+    hint = "register the name in obs/events.py (EVENTS, SPANS or SPAN_ATTRS)"
     interests = (ast.Call,)
 
     def __init__(self):
         super().__init__()
         # imported lazily-late so the checker module stays importable
         # even while obs/ is being refactored under it
-        from mpi_opt_tpu.obs.events import EVENTS, SPANS
+        from mpi_opt_tpu.obs.events import EVENTS, SPAN_ATTRS, SPANS
 
         self._tables = {"event": EVENTS, "span": SPANS}
+        self._span_attrs = SPAN_ATTRS
 
     def visit(self, node, ctx: FileContext) -> None:
         site = call_site(node)
@@ -82,3 +83,19 @@ class EventRegistryChecker(Checker):
                 f"unregistered {kind} name {name!r} — add it to "
                 f"obs/events.py {table}",
             )
+        if kind != "span":
+            return
+        # span ATTR keys are schema too (the trace/diff CLIs key on
+        # them): every literal keyword at a span call site must be in
+        # SPAN_ATTRS. **attrs forwarding (kw.arg is None) is a
+        # re-emission helper and is skipped, same rule as non-literal
+        # names above. (ISSUE 10 satellite: the registry's scanned
+        # surface now covers the attr namespace.)
+        for kw in node.keywords:
+            if kw.arg is not None and kw.arg not in self._span_attrs:
+                self.report(
+                    ctx,
+                    node,
+                    f"unregistered span attr {kw.arg!r} on span {name!r} — "
+                    "add it to obs/events.py SPAN_ATTRS",
+                )

@@ -997,7 +997,9 @@ def main(argv=None, *, _workload=None) -> int:
 
         return lint_main(argv[1:])
     # `mpi_opt_tpu trace FILE|DIR` renders phase-time attribution over
-    # JSONL metrics streams (obs/report.py); never touches jax
+    # JSONL metrics streams (obs/report.py); `trace --diff BASE NEW
+    # [--gate TOL.json]` compares two attributions and gates perf
+    # regressions (obs/diff.py). Never touches jax
     if argv and argv[0] == "trace":
         from mpi_opt_tpu.obs.report import trace_main
 

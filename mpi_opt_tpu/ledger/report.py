@@ -88,6 +88,9 @@ def _service_status(path: str) -> Optional[dict]:
         "slices": s.get("slices"),
         "preemptions": s.get("preemptions"),
         "program_cache": s.get("program_cache"),
+        # post-slice device-memory watermark (obs/memory.py via the
+        # scheduler's status write)
+        "device_memory": s.get("device_memory"),
     }
     # an ACTIVE tenant also reports what it is doing right now (phase
     # from its heartbeat's active-span field + current slice elapsed) —

@@ -13,9 +13,15 @@ time-to-first-trial) over one or many streams.
 Modules:
 - ``trace``   — the tracer: ``span``/``traced``/``configure``; costs
   nothing when no sink is configured (the ``null_logger`` contract).
-- ``events``  — the registry of every legal event/span name; a tier-1
-  test walks the codebase and fails on an unregistered name.
+- ``events``  — the registry of every legal event/span/attr name; a
+  tier-1 test walks the codebase and fails on an unregistered name.
 - ``report``  — the ``trace`` subcommand (merge by ``ts``, attribute).
+- ``diff``    — ``trace --diff``: two attributions become per-phase
+  deltas with a noise-model significance verdict, and ``--gate``
+  turns them into an exit code (the perf-regression gate, ISSUE 10).
+- ``memory``  — device-memory watermark telemetry: ``memory_stats()``
+  where the backend provides it, live-array accounting fallback;
+  feeds span attrs, bench records, and ``estimate_wave_size`` auto.
 """
 
 from mpi_opt_tpu.obs import trace  # noqa: F401

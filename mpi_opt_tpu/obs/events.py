@@ -86,12 +86,58 @@ SPANS = frozenset(
 )
 
 
+#: every legal span ATTRIBUTE key — the kwargs of ``trace.span(...)``
+#: calls plus the keys set on the yielded dict (``sp["bytes"] = n``) and
+#: the compile listener's synthesized attrs. The trace CLI, the diff
+#: layer, and outside aggregation key on these names, so they are
+#: schema the same way event/span names are: the ``event-registry``
+#: sweeplint checker rejects a literal ``span()`` keyword missing here
+#: (dict-set keys are registered by convention — AST can't prove a
+#: subscript target is a span dict).
+SPAN_ATTRS = frozenset(
+    {
+        # identity / position
+        "launch",  # 1-based launch ordinal (train)
+        "batch",  # driver batch ordinal (train)
+        "boundary",  # fused journal boundary ordinal (journal)
+        "gen",  # PBT generation (boundary op=exploit)
+        "gens",  # generations covered by one launch (train)
+        "rung",  # SHA rung ordinal (train, boundary op=rung_cut)
+        "bracket",  # hyperband/BOHB bracket (boundary op=suggest)
+        "waves",  # waves per generation (train, wave mode)
+        "step",  # snapshot step (save/restore)
+        "job",  # service tenant job id (slice/slice_setup)
+        # shape / volume
+        "members",  # population members in the phase
+        "steps",  # train steps in the segment
+        "n",  # generic count (journal records, suggest batch)
+        "items",  # manifest items (digest)
+        "bytes",  # bytes moved (stage_in/stage_out; set at exit)
+        "flops",  # segment FLOPs for achieved TF/s (set at exit)
+        # provenance
+        "op",  # boundary/digest flavor (exploit/rung_cut/suggest/...)
+        "backend",  # driver setup backend name
+        "workload",  # fused setup workload name
+        "cache",  # compile: cold | persistent (listener)
+        "during",  # compile: enclosing span name (listener)
+        # device-memory watermark (obs/memory.py; set at exit)
+        "mem_bytes",  # steady bytes_in_use at phase exit
+        "mem_peak_bytes",  # peak/watermark bytes at phase exit
+        "mem_src",  # accounting source: memory_stats | live_arrays
+    }
+)
+
+
 def is_event(name: str) -> bool:
     return name in EVENTS
 
 
 def is_span(name: str) -> bool:
     return name in SPANS
+
+
+def is_span_attr(name: str) -> bool:
+    return name in SPAN_ATTRS
 
 
 # -- scanner shims (ISSUE 9) ---------------------------------------------

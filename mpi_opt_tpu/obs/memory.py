@@ -1,0 +1,138 @@
+"""Device-memory watermark telemetry: what the sweep's state actually
+costs in HBM, from the system itself.
+
+The blind spot this closes (ISSUE 10): ``estimate_wave_size`` auto mode
+sized waves from an 8 GiB env default because NO layer ever measured
+device memory, and the bf16/residency plans in PERF_NOTES were built
+from hand-derived byte math. This module is the one home for reading
+it:
+
+- ``sample()`` — one reading of the device's memory accounting:
+  ``device.memory_stats()`` where the backend provides it (TPU: real
+  allocator counters including ``peak_bytes_in_use`` and
+  ``bytes_limit``), else a **live-array accounting fallback** (sum of
+  ``jax.live_arrays()`` byte sizes — exact for the arrays the sweep
+  holds, blind to allocator fragmentation and in-program temporaries;
+  the ``source`` field says which accounting produced the numbers so a
+  consumer never mistakes one for the other). The fallback's
+  ``peak_bytes`` is a process-lifetime running max over *samples*, so a
+  spike between samples is missed — honest steady-state, not a true
+  high-water mark.
+- ``note(sp)`` — attach the reading to an active span's attr dict
+  (``mem_bytes`` steady / ``mem_peak_bytes`` watermark / ``mem_src``)
+  at the phase boundaries that matter: train launches, wave staging,
+  snapshot saves. Zero work when tracing is disabled (the
+  ``null_logger`` contract — an untraced sweep never pays the
+  live-array walk).
+- ``measured_budget()`` — the device's reported ``bytes_limit`` for
+  ``estimate_wave_size`` auto mode (None where the backend reports
+  none; the resolution order — explicit arg, env override, THIS, 8 GiB
+  default — lives in train/staging.py).
+- ``watermark()`` — the record-shaped snapshot benches and the service
+  status embed beside trials/s.
+
+Attr names (``mem_bytes``/``mem_peak_bytes``/``mem_src``) are
+registered in obs/events.py SPAN_ATTRS; the trace CLI renders them as
+the per-phase memory column.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from mpi_opt_tpu.obs import trace
+
+# process-lifetime running peak for the live-array fallback (the real
+# allocator keeps its own peak; this is the best a host-side account
+# can do). Plain int under the GIL — approximate under races, which is
+# fine for a watermark.
+_LIVE_PEAK = 0
+
+
+def reset_peak() -> None:
+    """Drop the live-array fallback's running peak (tests; a bench that
+    measures phases back-to-back wants each phase's own watermark)."""
+    global _LIVE_PEAK
+    _LIVE_PEAK = 0
+
+
+def sample(device=None) -> Optional[dict]:
+    """One memory reading for ``device`` (default: first local device):
+    ``{"bytes_in_use", "peak_bytes", "bytes_limit", "source"}``, or
+    None when no accounting exists at all (jax-less environment)."""
+    global _LIVE_PEAK
+    try:
+        import jax
+
+        if device is None:
+            device = jax.local_devices()[0]
+    except Exception:
+        return None
+    stats = None
+    try:
+        stats = device.memory_stats()
+    except Exception:  # backends without the method raise, some return None
+        stats = None
+    if isinstance(stats, dict) and stats.get("bytes_in_use") is not None:
+        peak = stats.get("peak_bytes_in_use")
+        limit = stats.get("bytes_limit")
+        return {
+            "bytes_in_use": int(stats["bytes_in_use"]),
+            "peak_bytes": None if peak is None else int(peak),
+            "bytes_limit": None if limit is None else int(limit),
+            "source": "memory_stats",
+        }
+    # live-array fallback: exact for held state, blind to temporaries
+    try:
+        live = jax.live_arrays()
+    except Exception:
+        return None
+    in_use = 0
+    for a in live:
+        try:
+            in_use += int(a.nbytes)
+        except Exception:  # deleted/donated arrays mid-walk
+            pass
+    _LIVE_PEAK = max(_LIVE_PEAK, in_use)
+    return {
+        "bytes_in_use": in_use,
+        "peak_bytes": _LIVE_PEAK,
+        "bytes_limit": None,
+        "source": "live_arrays",
+    }
+
+
+def note(sp: dict, device=None) -> None:
+    """Attach the current reading to an active span's attr dict (the
+    mutable mapping ``trace.span`` yields). No-op when tracing is
+    disabled, so instrumented call sites cost nothing untraced."""
+    if not trace.enabled():
+        return
+    m = sample(device)
+    if m is None:
+        return
+    sp["mem_bytes"] = m["bytes_in_use"]
+    sp["mem_peak_bytes"] = (
+        m["bytes_in_use"] if m["peak_bytes"] is None else m["peak_bytes"]
+    )
+    sp["mem_src"] = m["source"]
+
+
+def measured_budget(device=None) -> Optional[int]:
+    """The device's reported memory capacity (``bytes_limit``), or None
+    when the backend provides no allocator stats (CPU here returns
+    None — the live-array fallback counts usage but knows no limit)."""
+    m = sample(device)
+    if m is None or m["source"] != "memory_stats":
+        return None
+    # `or None`: a backend reporting bytes_limit=0 has no usable limit —
+    # without this guard a zero budget would silently force wave size 1
+    # instead of falling through to the conservative default
+    return m["bytes_limit"] or None
+
+
+def watermark(device=None) -> Optional[dict]:
+    """The bench/status-record snapshot: ``sample()`` by its consumer-
+    facing name (benches embed it as ``device_memory``; the service
+    writes it into tenant status after each slice)."""
+    return sample(device)

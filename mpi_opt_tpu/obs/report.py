@@ -25,13 +25,20 @@ Coverage (attributed self-seconds / wall) can legitimately exceed 100%
 when a background transfer thread overlaps compute — that overlap is
 the staging engine doing its job, and burying it would hide the win.
 
+Phase rows also carry per-span SELF statistics (mean/sd/p50/p95 of
+exclusive seconds — what ``trace --diff``'s noise model judges) and a
+device-memory watermark column where spans carried obs/memory.py attrs.
+
 ``--json`` prints one machine-readable object (the bench/CI surface);
-text mode renders the table.
+text mode renders the table. ``--diff BASE NEW [--gate TOL.json]``
+dispatches to obs/diff.py: two attributions become per-phase deltas
+with a significance verdict, and the gate turns them into an exit code.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 from typing import Optional
@@ -139,16 +146,55 @@ def _phase_table(spans: list, wall: float) -> dict:
     for name in sorted(phases):
         group = phases[name]
         durs = sorted(float(r["dur_s"]) for r in group)
-        self_s = sum(float(r.get("self_s", r["dur_s"])) for r in group)
+        selfs = sorted(float(r.get("self_s", r["dur_s"])) for r in group)
+        self_s = sum(selfs)
+        n = len(group)
+        mean_self = self_s / n
+        # per-span SELF spread: what trace --diff's noise model judges
+        # significance against (self, not dur — a cold compile nested in
+        # launch 1's train span must not look like train-phase jitter)
+        sd_self = (
+            math.sqrt(sum((v - mean_self) ** 2 for v in selfs) / (n - 1))
+            if n >= 2
+            else None
+        )
+        # per-phase device-memory watermark (obs/memory.py span attrs):
+        # max over the phase's spans; None when untracked (CPU without
+        # accounting, pre-round-7 streams)
+        mem_peak = [r["mem_peak_bytes"] for r in group if isinstance(r.get("mem_peak_bytes"), (int, float))]
+        mem_steady = [r["mem_bytes"] for r in group if isinstance(r.get("mem_bytes"), (int, float))]
         out[name] = {
-            "count": len(group),
+            "count": n,
             "total_s": round(sum(durs), 4),
             "self_s": round(self_s, 4),
             "wall_pct": round(100.0 * self_s / wall, 2) if wall > 0 else None,
             "p50_s": round(_percentile(durs, 0.50), 4),
             "p95_s": round(_percentile(durs, 0.95), 4),
+            "mean_self_s": round(mean_self, 6),
+            "sd_self_s": None if sd_self is None else round(sd_self, 6),
+            "p50_self_s": round(_percentile(selfs, 0.50), 6),
+            "p95_self_s": round(_percentile(selfs, 0.95), 6),
+            "mem_peak_bytes": max(mem_peak) if mem_peak else None,
+            "mem_bytes": max(mem_steady) if mem_steady else None,
         }
     return out
+
+
+def _memory_summary(spans: list) -> Optional[dict]:
+    """The run-level device-memory watermark: the max over every span's
+    memory attrs (None when nothing carried them)."""
+    peaks = [r["mem_peak_bytes"] for r in spans if isinstance(r.get("mem_peak_bytes"), (int, float))]
+    if not peaks:
+        return None
+    steady = [r["mem_bytes"] for r in spans if isinstance(r.get("mem_bytes"), (int, float))]
+    srcs = sorted({r["mem_src"] for r in spans if isinstance(r.get("mem_src"), str)})
+    return {
+        "peak_bytes": int(max(peaks)),
+        "bytes_in_use": int(max(steady)) if steady else None,
+        # stable string|null schema even when merged streams mixed
+        # accountings (a TPU rank beside a CPU fallback stream)
+        "source": "+".join(srcs) if srcs else None,
+    }
 
 
 def _train_throughput(spans: list) -> Optional[dict]:
@@ -275,6 +321,7 @@ def attribute(streams: dict) -> dict:
         "compile": compile_rep,
         "train": _train_throughput(spans),
         "time_to_first_trial_s": min((v for _l, v in ttft), default=None),
+        "memory": _memory_summary(spans),
         "tenants": per_tenant,
     }
 
@@ -293,6 +340,7 @@ def bench_attribution(path: str) -> dict:
             "compile",
             "train",
             "time_to_first_trial_s",
+            "memory",
         )
     }
 
@@ -308,18 +356,28 @@ def _render_text(rep: dict) -> str:
         )
     ]
     if rep["phases"]:
-        lines.append(
+        # memory column only when some phase carried a watermark (an
+        # untraced-memory stream keeps the narrow historical table)
+        has_mem = any(p.get("mem_peak_bytes") for p in rep["phases"].values())
+        header = (
             f"  {'phase':<12} {'count':>6} {'total s':>9} {'self s':>9} "
             f"{'wall %':>7} {'p50 s':>8} {'p95 s':>8}"
         )
+        if has_mem:
+            header += f" {'mem MiB':>8}"
+        lines.append(header)
         for name, p in sorted(
             rep["phases"].items(), key=lambda kv: -kv[1]["self_s"]
         ):
             pct = "-" if p["wall_pct"] is None else f"{p['wall_pct']:.1f}"
-            lines.append(
+            row = (
                 f"  {name:<12} {p['count']:>6} {p['total_s']:>9.3f} "
                 f"{p['self_s']:>9.3f} {pct:>7} {p['p50_s']:>8.4f} {p['p95_s']:>8.4f}"
             )
+            if has_mem:
+                mem = p.get("mem_peak_bytes")
+                row += f" {'-' if mem is None else format(mem / (1 << 20), '.1f'):>8}"
+            lines.append(row)
     c = rep["compile"]
     if c.get("cold", {}).get("count") or c.get("persistent", {}).get("count"):
         lines.append(
@@ -342,6 +400,18 @@ def _render_text(rep: dict) -> str:
                 )
     if rep["time_to_first_trial_s"] is not None:
         lines.append(f"  time to first trial: {rep['time_to_first_trial_s']}s")
+    mem = rep.get("memory")
+    if mem is not None:
+        steady = mem.get("bytes_in_use")
+        lines.append(
+            f"  device memory: peak {mem['peak_bytes'] / (1 << 20):.1f} MiB"
+            + (
+                f", steady {steady / (1 << 20):.1f} MiB"
+                if steady is not None
+                else ""
+            )
+            + f" ({mem['source']})"
+        )
     if rep["tenants"]:
         for name, table in sorted(rep["tenants"].items()):
             busy = round(sum(p["self_s"] for p in table.values()), 3)
@@ -377,10 +447,36 @@ def trace_main(argv=None) -> int:
         metavar="FILE|DIR",
         help="metrics stream(s) (--metrics-file output), or directories "
         "to discover streams under (a launch --log-dir merges all ranks; "
-        "a service --state-dir merges all tenants)",
+        "a service --state-dir merges all tenants). With --diff: exactly "
+        "two targets, each a stream/dir, a `trace --json` attribution "
+        "file, or a bench record with an embedded trace",
     )
     p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--diff",
+        action="store_true",
+        help="compare two attributions (BASE NEW): per-phase deltas "
+        "judged against each phase's own measured jitter, compile "
+        "cold/persistent deltas, achieved-TF/s, time-to-first-trial and "
+        "device-memory watermark deltas (obs/diff.py)",
+    )
+    p.add_argument(
+        "--gate",
+        default=None,
+        metavar="TOL.json",
+        help="with --diff: apply per-phase tolerance budgets from this "
+        "file and exit 1 on regression (the bench-trajectory/CI gate; "
+        "see README: Observability for the file format)",
+    )
     args = p.parse_args(argv)
+    if args.gate and not args.diff:
+        p.error("--gate requires --diff")
+    if args.diff:
+        from mpi_opt_tpu.obs.diff import diff_main
+
+        return diff_main(
+            args.targets, json_out=args.json, gate_path=args.gate, error=p.error
+        )
 
     streams: dict = {}
 

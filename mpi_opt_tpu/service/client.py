@@ -204,6 +204,9 @@ def _collect_status(spool: Spool) -> dict:
             "best_score": s.get("best_score"),
             "program_cache": s.get("program_cache"),
             "first_slice_wall_s": s.get("first_slice_wall_s"),
+            # post-slice device-memory watermark (obs/memory.py via the
+            # scheduler): what this tenant's residency costs the device
+            "device_memory": s.get("device_memory"),
         }
         # an ACTIVE tenant surfaces what it is doing right now: the
         # phase from its heartbeat (fed by the active trace span) and
@@ -254,6 +257,9 @@ def status_main(argv) -> int:
             pc = j.get("program_cache") or {}
             if pc.get("hits") or pc.get("misses"):
                 extra += f" cache={pc.get('hits', 0)}h/{pc.get('misses', 0)}m"
+            mem = j.get("device_memory") or {}
+            if mem.get("peak_bytes"):
+                extra += f" mem={mem['peak_bytes'] / (1 << 20):.0f}MiB"
         if j.get("state") == "running" and (
             j.get("phase") or j.get("slice_elapsed_s") is not None
         ):

@@ -45,6 +45,7 @@ import time
 import traceback
 from typing import Callable, Optional
 
+from mpi_opt_tpu.obs import memory as obs_memory
 from mpi_opt_tpu.service import tenants as tstates
 from mpi_opt_tpu.service.programs import ProgramCache
 from mpi_opt_tpu.service.spool import Spool, TenantDir
@@ -411,6 +412,10 @@ class SweepService:
         prev_tag = os.environ.get("MPI_OPT_TPU_TRACE_TAG")
         if self.trace:
             os.environ["MPI_OPT_TPU_TRACE_TAG"] = status.get("tenant", "default")
+        # per-slice watermark window: the live-array fallback's running
+        # peak resets here, so the post-slice reading below is THIS
+        # slice's footprint, not a previous (possibly larger) tenant's
+        obs_memory.reset_peak()
         # the slice span emits AFTER cli.main restores the server's own
         # sink (trace nesting contract), so it lands in the SERVER
         # stream with the tenant's in-slice spans as its children
@@ -482,6 +487,27 @@ class SweepService:
             status["summary"] = summary
             if summary.get("best_score") is not None:
                 status["best_score"] = summary["best_score"]
+        # post-slice device-memory watermark (obs/memory.py): what this
+        # tenant's residency costs the shared device — the number the
+        # admission layer will need the day co-residency is attempted,
+        # surfaced today by `status`/`report DIR`. The `scope` field
+        # keeps it honest, per accounting: memory_stats' allocator peak
+        # cannot be reset and spans the SERVER's lifetime (a tiny tenant
+        # after a huge one would otherwise wear the big footprint); the
+        # live-array fallback's peak was reset at slice start, but it
+        # only observes when sampled — in-slice samples happen via the
+        # traced spans' memory.note, so without --trace the one sample
+        # below sees the post-slice residual, not the tenant's working
+        # set, and the label must say so
+        mem = obs_memory.watermark()
+        if mem is not None:
+            if mem["source"] != "live_arrays":
+                scope = "server"
+            elif self.trace:
+                scope = "slice"
+            else:
+                scope = "post_slice"
+            status["device_memory"] = dict(mem, scope=scope)
         t.write_status(status)
         self._wrote_status(t)
         name = status.get("tenant", "default")
@@ -509,6 +535,7 @@ class SweepService:
             boundaries=boundaries,
             wall_s=round(wall, 3),
             signal=delivered,
+            mem_peak_bytes=None if mem is None else mem.get("peak_bytes"),
         )
         if self.on_slice_end is not None:
             self.on_slice_end(t)

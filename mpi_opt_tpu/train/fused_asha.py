@@ -32,7 +32,7 @@ import hashlib
 import jax
 import numpy as np
 
-from mpi_opt_tpu.obs import trace
+from mpi_opt_tpu.obs import memory, trace
 from mpi_opt_tpu.ops.asha import asha_cut, asha_rungs
 from mpi_opt_tpu.train.common import (
     finite_winner,
@@ -288,6 +288,9 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
                     # full-rung FLOPs over a partial duration
                     if f:
                         sp["flops"] = f
+                    # post-barrier device-memory watermark: the rung's
+                    # cohort + activations just peaked
+                    memory.note(sp)
             if not defer:
                 record_rung(r, np_scores)
                 if journal is not None:

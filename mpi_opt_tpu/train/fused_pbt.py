@@ -31,7 +31,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
-from mpi_opt_tpu.obs import trace
+from mpi_opt_tpu.obs import memory, trace
 from mpi_opt_tpu.ops.pbt import PBTConfig, pbt_exploit_explore
 from mpi_opt_tpu.train.common import (
     finite_winner,
@@ -245,6 +245,7 @@ def _run_wave(
             dev = stage_in(pool, rows, mesh)
             n_bytes = tree_bytes(dev)
             sp["bytes"] = n_bytes
+            memory.note(sp)
         engine.note_bytes(n_bytes)
         st = PopState(params=dev["params"], momentum=dev["momentum"], step=dev["step"])
     st, _ = _wave_train_program(
@@ -577,6 +578,9 @@ def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gather
                 # never divides full-generation FLOPs by partial wall
                 if flops_gen:
                     sp["flops"] = flops_gen
+                # post-drain device-memory watermark: the generation's
+                # peak residency (two waves + activations) just happened
+                memory.note(sp)
             # journal this generation's members (pre-exploit scores +
             # the units they trained with) BEFORE the boundary snapshot;
             # a resumed generation verifies instead of re-writing
@@ -1054,6 +1058,9 @@ def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, expl
                 # WITHOUT the attr (no inflated TF/s from partial work)
                 if flops_gen:
                     _sp["flops"] = flops_gen * launch_lens[i]
+                # post-barrier device-memory watermark (obs/memory.py):
+                # resident population + activations just peaked
+                memory.note(_sp)
             # the fetches above are the launch's completion barrier
             # (block_until_ready is unreliable under the axon plugin —
             # PERF_NOTES.md), so the duration is measured AFTER them and

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mpi_opt_tpu.obs import trace
+from mpi_opt_tpu.obs import memory, trace
 from mpi_opt_tpu.ops.tpe import TPEConfig, tpe_suggest
 from mpi_opt_tpu.train.common import (
     finite_winner,
@@ -289,6 +289,9 @@ def fused_tpe(  # sweeplint: barrier(batch host loop: fetches obs ring for snaps
                     member_fail.append(int(fetch_global(fail_dev_g)))
                     if f:
                         sp["flops"] = f
+                    # post-barrier device-memory watermark: batch cohort
+                    # + obs ring resident
+                    memory.note(sp)
             if journal is not None:
                 # one record per suggestion of this batch (members are
                 # the sweep's global trial indices), journaled BEFORE

@@ -148,7 +148,7 @@ class StagingEngine:
 
     def _loop(self):  # sweeplint: barrier(the transfer thread IS the barrier: its whole job is host<->device copies)
         from mpi_opt_tpu.health import heartbeat
-        from mpi_opt_tpu.obs import trace
+        from mpi_opt_tpu.obs import memory, trace
 
         while True:
             job = self._q.get()
@@ -170,6 +170,10 @@ class StagingEngine:
                     on_host(host)
                     n_bytes = tree_bytes(host)
                     sp["bytes"] = n_bytes
+                    # post-fetch watermark: both waves (computing +
+                    # fetched) were resident just before this point — the
+                    # reading the wave-size estimate needs validated
+                    memory.note(sp)
                     with self._lock:
                         self.staged_bytes += n_bytes
                         self.transfers += 1
@@ -259,10 +263,14 @@ def estimate_wave_size(
 
     Per-member bytes come from ``jax.eval_shape`` over the trainer's
     init (abstract — no compute, no allocation): params at their own
-    dtypes plus momentum at the trainer's storage dtype. The budget is
-    ``budget_bytes``, else the device's reported ``bytes_limit``
-    (``memory_stats`` — absent on CPU), else the
-    ``MPI_OPT_TPU_DEVICE_BYTES`` env var, else a conservative 8 GiB.
+    dtypes plus momentum at the trainer's storage dtype. Budget
+    resolution order (ISSUE 10): ``budget_bytes`` argument, else the
+    ``MPI_OPT_TPU_DEVICE_BYTES`` env var (the operator's EXPLICIT
+    override — it must beat a measurement, or there is no way to size
+    waves for a device other than the one present), else the device's
+    MEASURED capacity (``obs.memory.measured_budget()``: the
+    ``memory_stats`` ``bytes_limit`` — absent on CPU), else a
+    conservative 8 GiB default.
     Only ~35% of it is offered to ONE wave's params+momentum: the wave
     loop keeps up to two waves resident (compute + in-flight fetch) and
     training needs activation/update headroom on top (the measured
@@ -286,14 +294,15 @@ def estimate_wave_size(
         )
     per_member = p_bytes + m_bytes
     if budget_bytes is None:
-        try:
-            stats = jax.local_devices()[0].memory_stats() or {}
-            budget_bytes = int(stats.get("bytes_limit", 0)) or None
-        except Exception:
-            budget_bytes = None
-    if budget_bytes is None:
         env = os.environ.get("MPI_OPT_TPU_DEVICE_BYTES")
-        budget_bytes = int(env) if env else 8 << 30
+        if env:
+            budget_bytes = int(env)
+    if budget_bytes is None:
+        from mpi_opt_tpu.obs import memory as obs_memory
+
+        budget_bytes = obs_memory.measured_budget()
+    if budget_bytes is None:
+        budget_bytes = 8 << 30
     n_pop = int(mesh.shape["pop"]) if mesh is not None else 1
     w = int(budget_bytes * 0.35 * n_pop // max(1, per_member))
     if mesh is not None and w > n_pop:

@@ -37,7 +37,7 @@ from typing import Any, Optional
 
 import orbax.checkpoint as ocp
 
-from mpi_opt_tpu.obs import trace
+from mpi_opt_tpu.obs import memory, trace
 from mpi_opt_tpu.utils import integrity
 
 
@@ -149,7 +149,8 @@ class SearchCheckpointer:
         # the save span bounds the HOST-side cost (state collection +
         # digest + async enqueue); orbax's background write time shows
         # up in close()'s save_wait span instead
-        with trace.span("save", step=step):
+        with trace.span("save", step=step) as sp:
+            memory.note(sp)  # pre-fetch watermark: device pool still resident
             search = {
                 "algorithm": algorithm.state_dict(),
                 "backend": backend.host_state_dict(),
@@ -272,7 +273,8 @@ class SweepCheckpointer:
         )
 
     def save(self, step: int, sweep: dict, meta_extra: dict) -> None:
-        with trace.span("save", step=step):
+        with trace.span("save", step=step) as sp:
+            memory.note(sp)  # snapshot-time watermark: sweep state resident
             meta = {"config": self.config, **meta_extra}
             # verified save: both items' content digests ride with the step
             # (sweep arrays are host-fetched by every caller, so digesting

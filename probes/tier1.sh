@@ -14,6 +14,7 @@
 #        T1_SKIP_FUSED_LEDGER_DRILL=1 probes/tier1.sh # skip the ledger drill
 #        T1_SKIP_SERVICE_DRILL=1 probes/tier1.sh # skip the sweep-service drill
 #        T1_SKIP_TRACE_DRILL=1 probes/tier1.sh # skip the span-trace drill
+#        T1_SKIP_PERFDIFF_DRILL=1 probes/tier1.sh # skip the trace-diff gate drill
 #        T1_SKIP_LINT_DRILL=1 probes/tier1.sh # skip the sweeplint drill
 set -o pipefail
 cd "$(dirname "$0")/.."
@@ -204,6 +205,70 @@ PYEOF
         echo "TRACE_DRILL=pass"
     else
         echo "TRACE_DRILL=FAIL"
+        rc=$(( rc == 0 ? 1 : rc ))
+    fi
+fi
+
+# -- perf-diff gate drill (trace diffing + regression gate, obs/diff.py) --
+# Two short traced fused sweeps — the second with a 0.25 s sleep shimmed
+# into every train phase (the seeded regression). `trace --diff --json
+# --gate` must exit 1 on the regressed pair and 0 for a run diffed
+# against itself: the end-to-end rc contract every future perf round's
+# CI verdict rides on. No TPU needed.
+if [ -z "$T1_SKIP_PERFDIFF_DRILL" ]; then
+    pd_rc=0
+    PD=$(mktemp -d /tmp/_t1_pdiff.XXXXXX)
+    # --gen-chunk 1: one launch (= one train span) per generation — the
+    # noise model needs repeated spans to measure the phase's spread
+    timeout -k 10 180 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        --workload fashion_mlp --algorithm pbt --fused --no-mesh \
+        --population 4 --generations 3 --steps-per-generation 2 \
+        --gen-chunk 1 --seed 0 \
+        --metrics-file "$PD/base.jsonl" --trace >/dev/null 2>&1 || pd_rc=1
+    # the regressed run: identical sweep, train-phase shim sleeps 0.25 s
+    timeout -k 10 180 env JAX_PLATFORMS=cpu python - "$PD" >/dev/null 2>&1 <<'PYEOF'
+import contextlib, sys, time
+from mpi_opt_tpu.obs import trace as _tr
+_orig = _tr.span
+@contextlib.contextmanager
+def slowed(name, **attrs):
+    with _orig(name, **attrs) as sp:
+        if name == "train":
+            time.sleep(0.25)
+        yield sp
+_tr.span = slowed
+from mpi_opt_tpu.cli import main
+d = sys.argv[1]
+sys.exit(main(["--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+               "--no-mesh", "--population", "4", "--generations", "3",
+               "--steps-per-generation", "2", "--gen-chunk", "1", "--seed", "0",
+               "--metrics-file", f"{d}/new.jsonl", "--trace"]))
+PYEOF
+    [ $? -eq 0 ] || pd_rc=1
+    printf '{"default": 10.0, "phases": {"train": 0.5}}' > "$PD/tol.json"
+    # a run diffed against itself gates clean (rc 0)...
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        trace --diff "$PD/base.jsonl" "$PD/base.jsonl" --json \
+        --gate "$PD/tol.json" >/dev/null 2>&1 || pd_rc=1
+    # ...and the seeded train-phase slowdown must trip the gate (rc 1)
+    # with the regression attributed to the train phase in the JSON
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        trace --diff "$PD/base.jsonl" "$PD/new.jsonl" --json \
+        --gate "$PD/tol.json" >"$PD/diff.json" 2>/dev/null
+    [ $? -eq 1 ] || pd_rc=1
+    python - "$PD/diff.json" <<'PYEOF' || pd_rc=1
+import json, sys
+rep = json.load(open(sys.argv[1]))
+assert rep["tool"] == "tracediff", rep
+assert rep["gate"]["ok"] is False, rep["gate"]
+assert "train" in rep["significant_regressions"], rep["significant_regressions"]
+assert any("train" in v for v in rep["gate"]["violations"]), rep["gate"]
+PYEOF
+    rm -rf "$PD"
+    if [ $pd_rc -eq 0 ]; then
+        echo "PERFDIFF_DRILL=pass"
+    else
+        echo "PERFDIFF_DRILL=FAIL"
         rc=$(( rc == 0 ? 1 : rc ))
     fi
 fi

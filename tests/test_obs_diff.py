@@ -1,0 +1,524 @@
+"""Perf-regression observability (ISSUE 10): trace diffing, the gate,
+and device-memory watermark telemetry.
+
+Golden trace-pair fixtures for the diff significance model: a
+noise-level delta stays silent, a seeded 2x train-phase regression
+flags, a missing phase reports asymmetrically, the ``--gate`` rc
+contract mirrors fsck/report --validate, and the ``--json`` schema is
+gated. Memory: the sampler's fallback accounting, span-attr wiring,
+and the trace table's memory column.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+
+import pytest
+
+from mpi_opt_tpu.obs import memory, trace
+from mpi_opt_tpu.obs.diff import (
+    apply_gate,
+    diff_attributions,
+    load_attribution,
+    validate_tolerances,
+)
+from mpi_opt_tpu.obs.report import _render_text, attribute, trace_main
+from mpi_opt_tpu.utils.metrics import MetricsLogger
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace_state():
+    saved = trace.save()
+    trace.deconfigure()
+    yield
+    trace.deconfigure(saved)
+
+
+# -- fixtures: synthetic multi-rank streams ------------------------------
+
+
+def _span(name, ts, dur, **attrs):
+    return {
+        "event": "span",
+        "span": name,
+        "dur_s": dur,
+        "self_s": attrs.pop("self_s", dur),
+        "ts": ts,
+        "tid": 0,
+        **attrs,
+    }
+
+
+def _rank_records(rank, *, train_scale=1.0, jitter=0.02, seed=0, phases=()):
+    """One rank's deterministic stream: 4 train launches + a save, plus
+    any extra single-span phases requested."""
+    rng = random.Random(seed * 31 + rank)
+    recs = []
+    ts = 100.0 + rank  # ranks interleave but stay ts-mergeable
+    for i in range(4):
+        d = 1.0 * train_scale * (1 + rng.uniform(-jitter, jitter))
+        ts += d + 0.05
+        recs.append(_span("train", ts, d, rank=rank, launch=i + 1, flops=1e12))
+    ts += 0.3
+    recs.append(_span("save", ts, 0.25 * (1 + rng.uniform(-jitter, jitter)), rank=rank))
+    for name in phases:
+        ts += 0.1
+        recs.append(_span(name, ts, 0.05, rank=rank))
+    return recs
+
+
+def _write_stream_dir(directory, **kw):
+    os.makedirs(directory, exist_ok=True)
+    for rank in (0, 1):
+        with open(os.path.join(directory, f"rank{rank}.out"), "w") as f:
+            for r in _rank_records(rank, **kw):
+                f.write(json.dumps(r) + "\n")
+    return directory
+
+
+def _attr(**kw):
+    return attribute(
+        {f"rank{r}.out": _rank_records(r, **kw) for r in (0, 1)}
+    )
+
+
+# -- the significance model ----------------------------------------------
+
+
+def test_phase_table_carries_self_stats():
+    rep = _attr(seed=1)
+    p = rep["phases"]["train"]
+    for key in ("mean_self_s", "sd_self_s", "p50_self_s", "p95_self_s"):
+        assert key in p, key
+    assert p["count"] == 8  # 4 launches x 2 ranks
+    assert p["sd_self_s"] is not None and p["sd_self_s"] < 0.05
+
+
+def test_diff_jitter_within_noise_stays_silent():
+    """A ~2-4% jitter-only pair (different RNG stream, same work) must
+    produce NO significant findings — the 'never pages anyone' half of
+    the noise-model contract."""
+    rep = diff_attributions(_attr(seed=1), _attr(seed=2))
+    assert rep["significant_regressions"] == []
+    assert rep["significant_improvements"] == []
+    assert rep["phases"]["train"]["direction"] == "flat"
+    assert abs(rep["phases"]["train"]["rel"]) < rep["phases"]["train"]["noise_rel"]
+
+
+def test_diff_seeded_2x_train_regression_flags():
+    """The 'always does' half: a 2x train-phase slowdown flags train —
+    and ONLY train (save is unchanged)."""
+    rep = diff_attributions(_attr(seed=1), _attr(seed=3, train_scale=2.0))
+    assert rep["significant_regressions"] == ["train"]
+    d = rep["phases"]["train"]
+    assert d["significant"] and d["direction"] == "regression"
+    assert d["rel"] == pytest.approx(1.0, abs=0.1)
+    assert rep["phases"]["save"]["direction"] == "flat"
+    # the improvement direction is symmetric arithmetic, asymmetric verdict
+    back = diff_attributions(_attr(seed=3, train_scale=2.0), _attr(seed=1))
+    assert back["significant_improvements"] == ["train"]
+    assert back["significant_regressions"] == []
+
+
+def test_diff_missing_phase_reported_asymmetrically():
+    rep = diff_attributions(
+        _attr(seed=1, phases=("digest",)), _attr(seed=2, phases=("stage_in",))
+    )
+    assert [p["span"] for p in rep["only_in_base"]] == ["digest"]
+    assert [p["span"] for p in rep["only_in_new"]] == ["stage_in"]
+    # neither direction invents a phase pair, and under the DEFAULT
+    # budget a come-and-go phase does not gate (instrumentation evolves)
+    assert "digest" not in rep["phases"] and "stage_in" not in rep["phases"]
+    gate = apply_gate(rep, {})
+    assert gate["ok"], gate["violations"]
+    # but a phase the operator EXPLICITLY budgeted that vanished from
+    # the new side is lost coverage — the gate must fail, not pass
+    # precisely when the watched phase became unmeasurable
+    gate = apply_gate(rep, {"phases": {"digest": 0.1}})
+    assert not gate["ok"]
+    assert any("missing from the new run" in v for v in gate["violations"])
+    # unless it was also ignored (explicitly waived)
+    gate = apply_gate(rep, {"phases": {"digest": 0.1}, "ignore": ["digest"]})
+    assert gate["ok"], gate["violations"]
+
+
+def test_single_span_phases_need_gross_change():
+    """One sample carries no spread: only a change past the coarse
+    single-sample floor may flag (a 30% wiggle on a one-shot setup span
+    is indistinguishable from environment)."""
+    base = attribute({"s": [_span("setup", 101.0, 1.0)]})
+    mild = attribute({"s": [_span("setup", 101.3, 1.3)]})
+    gross = attribute({"s": [_span("setup", 102.9, 2.9)]})
+    assert diff_attributions(base, mild)["significant_regressions"] == []
+    assert diff_attributions(base, gross)["significant_regressions"] == ["setup"]
+
+
+def test_significance_judged_on_self_time_not_duration():
+    """A cold compile nested inside launch 1's train span inflates its
+    DURATION but not its self time — the diff must not mistake a
+    compile-placement change for a train regression."""
+    def recs(compile_s):
+        train1 = _span("train", 103.0 + compile_s, 1.0 + compile_s, self_s=1.0, launch=1)
+        comp = _span("compile", 102.5, compile_s, cache="cold")
+        rest = [
+            _span("train", 105.0 + compile_s + i, 1.0, launch=2 + i) for i in range(3)
+        ]
+        return [comp, train1] + rest
+
+    rep = diff_attributions(
+        attribute({"s": recs(2.0)}), attribute({"s": recs(6.0)})
+    )
+    assert "train" not in rep["significant_regressions"]
+    # the compile delta is still visible where it belongs
+    assert rep["compile"]["cold"]["delta_total_s"] == pytest.approx(4.0)
+
+
+def test_mixed_legacy_and_self_stat_sides_compare_one_metric():
+    """Diffing a round-7 attribution against a legacy embed (no self
+    stats) must fall back to p50_s on BOTH sides — a per-side fallback
+    would compare exclusive seconds with inclusive ones and invent a
+    regression out of metric mixing."""
+    new = _attr(seed=1)
+    legacy = json.loads(json.dumps(_attr(seed=2)))  # deep copy
+    for p in legacy["phases"].values():
+        for k in ("mean_self_s", "sd_self_s", "p50_self_s", "p95_self_s"):
+            del p[k]
+    rep = diff_attributions(legacy, new)
+    assert rep["phases"]["train"]["metric"] == "p50_s"
+    assert rep["phases"]["train"]["base_metric_s"] == legacy["phases"]["train"]["p50_s"]
+    assert rep["significant_regressions"] == []
+
+
+# -- the gate -------------------------------------------------------------
+
+
+def test_gate_budgets_and_rc_contract(tmp_path, capsys):
+    base = _write_stream_dir(str(tmp_path / "base"), seed=1)
+    new = _write_stream_dir(str(tmp_path / "new"), seed=3, train_scale=2.0)
+    tol = str(tmp_path / "tol.json")
+    with open(tol, "w") as f:
+        json.dump({"default": 10.0, "phases": {"train": 0.5}}, f)
+    # a run diffed against itself gates clean (rc 0)
+    assert trace_main(["--diff", base, base, "--json", "--gate", tol]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["gate"]["ok"] is True
+    # the seeded regression exits 1 with the violation named
+    assert trace_main(["--diff", base, new, "--json", "--gate", tol]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["gate"]["ok"] is False
+    assert any("train" in v for v in rep["gate"]["violations"])
+    # without --gate the same diff is informational: rc 0
+    assert trace_main(["--diff", base, new, "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["gate"] is None
+
+
+def test_gate_compile_ttft_and_memory_budgets():
+    base = {
+        "phases": {},
+        "compile": {"cold": {"count": 1, "total_s": 5.0}, "persistent": {"count": 2, "total_s": 0.2}},
+        "train": {"tflops_per_sec": 33.0},
+        "time_to_first_trial_s": 10.0,
+        "wall_s": 100.0,
+        "memory": {"peak_bytes": 1000},
+    }
+    new = {
+        "phases": {},
+        "compile": {"cold": {"count": 4, "total_s": 20.0}, "persistent": {"count": 0, "total_s": 0.0}},
+        "train": {"tflops_per_sec": 20.0},
+        "time_to_first_trial_s": 30.0,
+        "wall_s": 140.0,
+        "memory": {"peak_bytes": 2000},
+    }
+    rep = diff_attributions(base, new)
+    gate = apply_gate(
+        rep,
+        {
+            "max_cold_compile_increase": 0,
+            "ttft_max_rel_increase": 0.5,
+            "tflops_max_rel_decrease": 0.2,
+            "wall_max_rel_increase": 0.25,
+            "memory_max_rel_increase": 0.5,
+        },
+    )
+    assert not gate["ok"]
+    text = "\n".join(gate["violations"])
+    for needle in ("cold compile", "time-to-first-trial", "TF/s", "wall", "memory"):
+        assert needle in text, (needle, text)
+
+
+def test_gate_tolerance_typos_are_usage_errors(tmp_path):
+    with pytest.raises(ValueError, match="unknown tolerance keys"):
+        validate_tolerances({"defualt": 0.2})
+    # value TYPES are refused up front too — a null budget surviving to
+    # apply_gate would traceback only after a bench run was paid for
+    with pytest.raises(ValueError, match="must be a number"):
+        validate_tolerances({"phases": {"train": None}})
+    with pytest.raises(ValueError, match="must be a number"):
+        validate_tolerances({"default": [0.1]})
+    with pytest.raises(ValueError, match="must be a number"):
+        validate_tolerances({"default": True})
+    with pytest.raises(ValueError, match="list of span names"):
+        validate_tolerances({"ignore": "train"})
+    with pytest.raises(ValueError, match="boolean"):
+        validate_tolerances({"require_significant": 1})
+    base = _write_stream_dir(str(tmp_path / "b"), seed=1)
+    tol = str(tmp_path / "tol.json")
+    with open(tol, "w") as f:
+        json.dump({"defualt": 0.2}, f)
+    with pytest.raises(SystemExit) as e:
+        trace_main(["--diff", base, base, "--gate", tol])
+    assert e.value.code == 2
+    # --gate without --diff and wrong target counts are usage errors too
+    with pytest.raises(SystemExit):
+        trace_main([base, "--gate", tol])
+    with pytest.raises(SystemExit):
+        trace_main(["--diff", base, "--json"])
+
+
+# -- loading --------------------------------------------------------------
+
+
+def test_diff_loads_bench_embedded_attributions(tmp_path, capsys):
+    """BENCH_r0*.json wrappers and bench stdout records load directly:
+    the BENCH trajectory is diffable without keeping raw streams."""
+    attr_base = _attr(seed=1)
+    attr_new = _attr(seed=3, train_scale=2.0)
+    wrapper = str(tmp_path / "BENCH_r06.json")  # driver wrapper shape
+    with open(wrapper, "w") as f:
+        json.dump({"n": 6, "rc": 0, "parsed": {"metric": "m", "value": 1.0, "trace": attr_base}}, f)
+    record = str(tmp_path / "bench_new.json")  # bench.py stdout record
+    with open(record, "w") as f:
+        json.dump({"metric": "m", "value": 0.5, "trace": attr_new}, f)
+    assert load_attribution(wrapper)["phases"]["train"]["count"] == 8
+    assert trace_main(["--diff", wrapper, record, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["significant_regressions"] == ["train"]
+
+
+def test_diff_refuses_pre_trace_bench_records(tmp_path, capsys):
+    """BENCH_r01-r05 predate tracing: a record without an embedded
+    attribution is a clear error (rc 1), never a silent empty diff."""
+    legacy = str(tmp_path / "BENCH_r05.json")
+    with open(legacy, "w") as f:
+        json.dump({"parsed": {"metric": "m", "value": 8.8, "unit": "trials/sec/chip"}}, f)
+    good = str(tmp_path / "good.json")
+    with open(good, "w") as f:
+        json.dump({"trace": _attr(seed=1)}, f)
+    assert trace_main(["--diff", legacy, good, "--json"]) == 1
+    out = capsys.readouterr()
+    assert "no trace attribution" in out.err
+    json.loads(out.out)  # --json stdout stays machine-parseable
+
+
+def test_multi_record_jsonl_is_ambiguous_not_first_line(tmp_path):
+    """bench_all stdout saved to a file (one record per line, several
+    embedding traces) must refuse as ambiguous — silently diffing only
+    line 1 would report one config as if it covered the set. A
+    single-trace multi-record file resolves to that one trace."""
+    r1 = {"config": 1, "metric": "a", "value": 1.0, "trace": _attr(seed=1)}
+    r2 = {"config": 2, "metric": "b", "value": 1.0, "trace": _attr(seed=2)}
+    multi = str(tmp_path / "all.jsonl")
+    with open(multi, "w") as f:
+        f.write(json.dumps(r1) + "\n" + json.dumps(r2) + "\n")
+    with pytest.raises(ValueError, match="2 embedded trace attributions"):
+        load_attribution(multi)
+    single = str(tmp_path / "one.jsonl")
+    with open(single, "w") as f:
+        f.write(json.dumps(r1) + "\n")
+        f.write(json.dumps({"config": 2, "metric": "b", "value": 1.0, "trace": None}) + "\n")
+    assert load_attribution(single)["phases"]["train"]["count"] == 8
+
+
+def test_diff_trace_json_file_roundtrip(tmp_path, capsys):
+    """`trace FILE --json` output is itself a --diff input (the
+    attribution-file shape), so saved CI artifacts diff directly."""
+    d = _write_stream_dir(str(tmp_path / "run"), seed=1)
+    assert trace_main([d, "--json"]) == 0
+    saved = str(tmp_path / "attr.json")
+    with open(saved, "w") as f:
+        f.write(capsys.readouterr().out)
+    assert trace_main(["--diff", saved, d, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["significant_regressions"] == []
+
+
+def test_diff_json_schema(tmp_path, capsys):
+    base = _write_stream_dir(str(tmp_path / "b"), seed=1)
+    assert trace_main(["--diff", base, base, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    for key in (
+        "tool",
+        "schema_version",
+        "base",
+        "new",
+        "phases",
+        "only_in_base",
+        "only_in_new",
+        "compile",
+        "train",
+        "time_to_first_trial",
+        "wall",
+        "memory",
+        "significant_regressions",
+        "significant_improvements",
+        "gate",
+    ):
+        assert key in rep, key
+    assert rep["tool"] == "tracediff"
+    d = rep["phases"]["train"]
+    for key in (
+        "base",
+        "new",
+        "delta_total_s",
+        "delta_self_s",
+        "delta_p50_s",
+        "delta_p95_s",
+        "metric",
+        "rel",
+        "noise_rel",
+        "significant",
+        "direction",
+    ):
+        assert key in d, key
+
+
+# -- device-memory watermark telemetry -----------------------------------
+
+
+def test_memory_sample_on_cpu_uses_live_array_fallback():
+    """This container's CPU backend reports memory_stats()=None, so the
+    sampler must fall back to live-array accounting and SAY so."""
+    import jax.numpy as jnp
+
+    keep = jnp.ones((1024,), jnp.float32)  # >= 4 KiB provably live
+    memory.reset_peak()
+    m = memory.sample()
+    assert m is not None
+    assert m["source"] in ("memory_stats", "live_arrays")
+    assert m["bytes_in_use"] >= keep.nbytes
+    assert m["peak_bytes"] >= m["bytes_in_use"]
+    if m["source"] == "live_arrays":
+        assert m["bytes_limit"] is None
+        assert memory.measured_budget() is None  # no limit -> no budget
+
+
+def test_measured_budget_zero_limit_means_no_budget(monkeypatch):
+    """A backend whose allocator reports bytes_limit=0 has no USABLE
+    limit: measured_budget must return None (falling through to the
+    8 GiB default) rather than a zero budget that would silently force
+    wave size 1."""
+
+    class FakeDev:
+        def memory_stats(self):
+            return {"bytes_in_use": 10, "peak_bytes_in_use": 20, "bytes_limit": 0}
+
+    assert memory.measured_budget(FakeDev()) is None
+
+    class RealDev(FakeDev):
+        def memory_stats(self):
+            return {"bytes_in_use": 10, "bytes_limit": 16 << 30}
+
+    assert memory.measured_budget(RealDev()) == 16 << 30
+
+
+def test_memory_note_attaches_span_attrs_only_when_traced(tmp_path):
+    sp: dict = {}
+    memory.note(sp)  # tracing disabled: zero work, zero attrs
+    assert sp == {}
+    m = MetricsLogger(path=str(tmp_path / "m.jsonl"))
+    prior = trace.configure(m)
+    try:
+        with trace.span("save", step=1) as live_sp:
+            memory.note(live_sp)
+    finally:
+        trace.deconfigure(prior)
+        m.close()
+    with open(tmp_path / "m.jsonl") as f:
+        rec = [json.loads(l) for l in f if '"span"' in l][0]
+    assert rec["mem_bytes"] >= 0
+    assert rec["mem_peak_bytes"] >= rec["mem_bytes"]
+    assert rec["mem_src"] in ("memory_stats", "live_arrays")
+
+
+def test_memory_column_in_attribution_and_text():
+    recs = [
+        _span("train", 101.0, 1.0, mem_bytes=100, mem_peak_bytes=1 << 20, mem_src="live_arrays"),
+        _span("save", 102.0, 0.2),
+    ]
+    rep = attribute({"s": recs})
+    assert rep["memory"] == {
+        "peak_bytes": 1 << 20,
+        "bytes_in_use": 100,
+        "source": "live_arrays",
+    }
+    assert rep["phases"]["train"]["mem_peak_bytes"] == 1 << 20
+    assert rep["phases"]["save"]["mem_peak_bytes"] is None
+    text = _render_text(rep)
+    assert "mem MiB" in text and "device memory: peak 1.0 MiB" in text
+    # mixed accountings across merged streams keep the string schema
+    mixed = attribute(
+        {
+            "tpu": [_span("train", 101.0, 1.0, mem_peak_bytes=2048, mem_src="memory_stats")],
+            "cpu": [_span("train", 102.0, 1.0, mem_peak_bytes=1024, mem_src="live_arrays")],
+        }
+    )
+    assert mixed["memory"]["source"] == "live_arrays+memory_stats"
+    # a stream with no memory attrs keeps the narrow historical table
+    bare = attribute({"s": [_span("train", 101.0, 1.0)]})
+    assert bare["memory"] is None
+    assert "mem MiB" not in _render_text(bare)
+
+
+def test_traced_fused_sweep_carries_memory_watermarks(tmp_path):
+    """End to end on CPU: a traced fused sweep's train/save spans carry
+    mem attrs from the live-array fallback, and the trace CLI reports
+    the run-level watermark (the acceptance-criteria drill shape)."""
+    from mpi_opt_tpu.cli import main
+
+    path = str(tmp_path / "m.jsonl")
+    rc = main(
+        [
+            "--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+            "--no-mesh", "--population", "4", "--generations", "2",
+            "--steps-per-generation", "2", "--seed", "0",
+            "--checkpoint-dir", str(tmp_path / "ck"),
+            "--metrics-file", path, "--trace",
+        ]
+    )
+    assert rc == 0
+    rep = attribute({"m": [json.loads(l) for l in open(path) if l.strip()]})
+    assert rep["memory"] is not None and rep["memory"]["peak_bytes"] > 0
+    assert rep["phases"]["train"]["mem_peak_bytes"] is not None
+    assert rep["phases"]["save"]["mem_peak_bytes"] is not None
+
+
+# -- registry: the attr namespace is schema too --------------------------
+
+
+def test_span_attr_registry_checker_flags_unregistered_kwargs():
+    from mpi_opt_tpu.analysis.checkers_registry import EventRegistryChecker
+    from mpi_opt_tpu.analysis.core import check_source
+
+    bad = (
+        "from mpi_opt_tpu.obs import trace\n"
+        "with trace.span('train', zorch=1):\n"
+        "    pass\n"
+    )
+    findings = check_source(bad, checkers=[EventRegistryChecker()])
+    assert len(findings) == 1 and "zorch" in findings[0].message
+    good = (
+        "from mpi_opt_tpu.obs import trace\n"
+        "with trace.span('train', launch=1, mem_peak_bytes=2) as sp:\n"
+        "    sp['flops'] = 1\n"
+    )
+    assert check_source(good, checkers=[EventRegistryChecker()]) == []
+
+
+def test_memory_attrs_registered():
+    from mpi_opt_tpu.obs.events import SPAN_ATTRS, is_span_attr
+
+    for name in ("mem_bytes", "mem_peak_bytes", "mem_src", "flops", "bytes"):
+        assert is_span_attr(name), name
+    assert "zorch" not in SPAN_ATTRS
