@@ -113,20 +113,49 @@ def bench_tpu(args):
     trace_rep = None
     if trace_prior is not None:
         from mpi_opt_tpu.obs import trace as _trace
-        from mpi_opt_tpu.obs.report import bench_attribution
 
         _trace.deconfigure(trace_prior)
         trace_metrics.close()
-        trace_rep = bench_attribution(trace_path)
-        log(f"[bench] trace stream {trace_path}: coverage {trace_rep['coverage']}")
     # device-memory watermark (obs/memory.py): sampled AFTER the
-    # measured run, while the sweep's state is still resident — the
-    # number the wave-size/bf16 planning needs measured, not derived
+    # measured run while the sweep's state is still resident, and
+    # BEFORE the cap probe below — peak_bytes_in_use is process-
+    # lifetime and cannot be reset, so the probe's ~100 MiB matmul
+    # buffers would otherwise wear into the sweep's recorded watermark
+    # (the number the wave-size/bf16 planning consumes)
     from mpi_opt_tpu.obs import memory as _obs_memory
 
     device_memory = _obs_memory.watermark()
     if device_memory is not None:
         log(f"[bench] device memory: {device_memory}")
+    # the cap is measured AFTER tracing deconfigures (its probe compiles
+    # must not pollute the attribution) and BEFORE the attribution is
+    # built, so the embedded roofline is judged against the MEASURED
+    # roof of this very device, not a calibration-table stand-in
+    cap_tf = measure_platform_cap() if jax.default_backend() == "tpu" else None
+    if trace_prior is not None:
+        from mpi_opt_tpu.obs.report import bench_attribution
+
+        trace_rep = bench_attribution(trace_path, peak_tflops=cap_tf)
+        log(f"[bench] trace stream {trace_path}: coverage {trace_rep['coverage']}")
+        # intra-phase verdicts (ISSUE 11): the embed carries the full
+        # bubbles/staging/roofline sections; the log shows the headline
+        bub, roof = trace_rep.get("bubbles"), trace_rep.get("roofline")
+        if bub is not None and bub.get("idle_frac") is not None:
+            log(f"[bench] idle fraction {bub['idle_frac']:.1%} "
+                f"({bub['idle_s']}s over {bub['gaps']} gap(s); "
+                f"by cause: {bub['by_cause']})")
+        stg = trace_rep.get("staging")
+        if stg is not None and stg.get("overlap_frac") is not None:
+            log(f"[bench] staging overlap {stg['overlap_frac']:.1%} "
+                f"(hidden {stg['overlap_s']}s of {stg['transfer_s']}s)")
+        if roof is not None:
+            if roof.get("mxu_frac") is not None:
+                log(f"[bench] roofline: {roof['bound']} "
+                    f"(MXU {roof['mxu_frac']:.1%}, cap {roof['peak_tflops']} "
+                    f"TF/s [{roof['peak_source']}])")
+            else:
+                log(f"[bench] roofline: {roof['bound']} (no platform cap — "
+                    "measured on TPU backends only; MXU fraction unavailable)")
     trials = population * generations
     tps = trials / wall
     # flops accounting AFTER the timed window (it lowers/compiles tiny
@@ -144,7 +173,6 @@ def bench_tpu(args):
     wall_to_target = _wtt(result, wall, args.target_acc)
 
     util = mfu(flops, wall, jax.devices()[0])
-    cap_tf = measure_platform_cap() if jax.default_backend() == "tpu" else None
     log(f"[bench] tpu: {trials} member-gens in {wall:.2f}s -> {tps:.3f} trials/s/chip; "
         f"best={result['best_score']:.3f} curve={[round(v, 3) for v in curve]}")
     if flops:
@@ -488,7 +516,8 @@ def main():
         "platform_matmul_tflops": tpu["platform_matmul_tflops"],
         "mfu_vs_platform_cap": tpu["mfu_vs_platform_cap"],
         # span-trace phase attribution (obs/): compile vs train vs save
-        # seconds + achieved TF/s per launch + time-to-first-trial —
+        # seconds + achieved TF/s per launch + time-to-first-trial, plus
+        # the round-8 intra-phase sections (bubbles/staging/roofline) —
         # None under --no-trace
         "trace": tpu["trace"],
         "trace_stream": tpu["trace_stream"],

@@ -45,10 +45,14 @@ def traced_config(fn, trace_dir, config_id: int):
     """Run one config under span tracing (obs/trace.py) and attach the
     phase-attribution JSON to its record — BENCH_r06+ carries a
     compile/train/save breakdown beside trials/s instead of one opaque
-    wall number. ``trace_dir=None`` runs untraced (--no-trace). Either
-    way the record leaves versioned (``schema_version``) and carrying
-    the device-memory watermark — the drift gate and the trajectory
-    diff both depend on the shape being declared, not inferred."""
+    wall number, plus the round-8 intra-phase sections (device-idle
+    ``bubbles``, staging ``overlap_frac``, the ``roofline`` verdict) so
+    every trajectory round is diffable/gateable on idle fraction, MXU
+    utilization, and overlap efficiency, not just phase walls.
+    ``trace_dir=None`` runs untraced (--no-trace). Either way the
+    record leaves versioned (``schema_version``) and carrying the
+    device-memory watermark — the drift gate and the trajectory diff
+    both depend on the shape being declared, not inferred."""
     from mpi_opt_tpu.obs import memory as obs_memory
 
     # per-config watermark window: the live-array fallback's peak is a
@@ -75,6 +79,12 @@ def traced_config(fn, trace_dir, config_id: int):
         metrics.close()
     rec["trace"] = bench_attribution(path)
     rec["trace_stream"] = path
+    roof = (rec["trace"] or {}).get("roofline")
+    if roof is not None:
+        mxu, idle = roof.get("mxu_frac"), roof.get("idle_frac")
+        log(f"[bench_all] config {config_id} roofline: {roof['bound']}"
+            + (f" (MXU {mxu:.1%})" if mxu is not None else " (no platform cap)")
+            + (f", idle {idle:.1%}" if idle is not None else ""))
     return _finish_record(rec)
 
 

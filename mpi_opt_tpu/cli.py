@@ -1194,7 +1194,9 @@ def _run_sweep(args, parser, _workload=None) -> int:
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
     _wire_integrity_observer(metrics)
     _wire_trace(args, metrics)  # restored by main's finally
-    with _trace.span("setup", backend=args.backend):
+    with _trace.span("setup", backend=args.backend) as _setup_sp:
+        # device kind keys the roofline's platform-cap calibration
+        _trace.note_device(_setup_sp)
         backend = get_backend(args.backend, workload, **backend_kwargs)
     checkpointer = None
     restored_step = None

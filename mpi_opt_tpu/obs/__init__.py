@@ -22,6 +22,15 @@ Modules:
 - ``memory``  — device-memory watermark telemetry: ``memory_stats()``
   where the backend provides it, live-array accounting fallback;
   feeds span attrs, bench records, and ``estimate_wave_size`` auto.
+- ``bubbles`` — intra-phase attribution (ISSUE 11): device-idle gaps
+  between busy spans attributed by cause, the staging engine's
+  overlap accounting promoted to per-run trace evidence, and the
+  roofline verdict (compute-/transfer-/bubble-bound against a
+  platform cap) the gate budgets via ``idle_frac``/``min_overlap``/
+  ``min_mxu_frac``.
+- ``timeline`` — ``trace --timeline OUT.json``: the merged span
+  streams as Chrome trace-event JSON (Perfetto-loadable), per-rank
+  process rows, per-thread tracks, and a synthetic device-idle track.
 """
 
 from mpi_opt_tpu.obs import trace  # noqa: F401

@@ -55,8 +55,21 @@ append-only pile of JSON. Tolerance file keys (all optional)::
       "tflops_max_rel_decrease": 0.2,   # achieved-TF/s budget
       "wall_max_rel_increase": 0.25,    # whole-run wall budget
       "memory_max_rel_increase": 0.25,  # device-memory watermark budget
-      "value_max_rel_regression": 0.25  # bench headline value (bench_gate)
+      "value_max_rel_regression": 0.25, # bench headline value (bench_gate)
+      "idle_frac": 0.25,                # max device-idle fraction (NEW side)
+      "min_overlap": 0.6,               # min staging overlap fraction (NEW side)
+      "min_mxu_frac": 0.15              # min achieved/cap fraction (NEW side)
     }
+
+The last three (ISSUE 11) budget the NEW run's ABSOLUTE intra-phase
+numbers (obs/bubbles.py), not deltas — an idle-fraction ceiling, a
+staging-overlap floor, and an MXU-utilization floor. ``idle_frac`` on
+an attribution without bubble analysis (a pre-round-8 embed) is a
+violation (lost coverage where someone declared they care);
+``min_overlap`` skips runs that staged nothing (a resident run has no
+transfer to hide); ``min_mxu_frac`` is a violation when achieved TF/s
+or the platform cap is unmeasured (pass ``--peak-tflops`` or run on a
+calibrated device kind).
 
 Unknown keys are refused (a typo'd budget must not silently gate
 nothing). The ``--json`` output is a stable schema mirroring
@@ -93,6 +106,9 @@ _TOL_KEYS = frozenset(
         "wall_max_rel_increase",
         "memory_max_rel_increase",
         "value_max_rel_regression",
+        "idle_frac",
+        "min_overlap",
+        "min_mxu_frac",
     }
 )
 
@@ -135,10 +151,12 @@ def _embedded_attribution(doc):
     return None
 
 
-def load_attribution(target: str) -> dict:
+def load_attribution(target: str, peak_tflops=None) -> dict:
     """Attribution for ``target`` (stream file / stream dir / trace
     --json file / bench record file). Raises ValueError/OSError with an
-    actionable message."""
+    actionable message. ``peak_tflops`` feeds the roofline when the
+    target is a raw stream/dir; embedded attributions keep the cap they
+    were built with."""
     from mpi_opt_tpu.obs.report import attribute, discover_streams, load_stream
 
     if os.path.isdir(target):
@@ -146,7 +164,8 @@ def load_attribution(target: str) -> dict:
         if not hits:
             raise ValueError(f"{target}: no metrics streams found")
         return attribute(
-            {os.path.relpath(p, target): load_stream(p) for p in hits}
+            {os.path.relpath(p, target): load_stream(p) for p in hits},
+            peak_tflops=peak_tflops,
         )
     # stream-vs-document sniff on the FIRST line only: a metrics stream
     # is one complete JSON event object per line, so line 1 decides the
@@ -197,7 +216,7 @@ def load_attribution(target: str) -> dict:
     records = load_stream(target)
     if not records:
         raise ValueError(f"{target}: no event records (not a metrics stream?)")
-    return attribute({os.path.basename(target): records})
+    return attribute({os.path.basename(target): records}, peak_tflops=peak_tflops)
 
 
 # -- the noise model ------------------------------------------------------
@@ -368,6 +387,40 @@ def diff_attributions(
             "delta_bytes": n_mem - b_mem,
             "rel": _rel(b_mem, n_mem) and round(_rel(b_mem, n_mem), 4),
         }
+    # intra-phase sections (ISSUE 11): present when EITHER side carries
+    # them — a one-sided section is how a legacy embed diffs against a
+    # round-8+ stream without crashing or hiding the new measurement
+    bubbles = None
+    b_i = (base.get("bubbles") or {}).get("idle_frac")
+    n_i = (new.get("bubbles") or {}).get("idle_frac")
+    if b_i is not None or n_i is not None:
+        bubbles = {
+            "base_idle_frac": b_i,
+            "new_idle_frac": n_i,
+            "delta": round(n_i - b_i, 4) if b_i is not None and n_i is not None else None,
+        }
+    staging = None
+    b_o = (base.get("staging") or {}).get("overlap_frac")
+    n_o = (new.get("staging") or {}).get("overlap_frac")
+    if base.get("staging") is not None or new.get("staging") is not None:
+        staging = {
+            "base_overlap_frac": b_o,
+            "new_overlap_frac": n_o,
+            "delta": round(n_o - b_o, 4) if b_o is not None and n_o is not None else None,
+            "base_wait_s": (base.get("staging") or {}).get("wait_s"),
+            "new_wait_s": (new.get("staging") or {}).get("wait_s"),
+        }
+    roofline = None
+    b_r, n_r = base.get("roofline") or {}, new.get("roofline") or {}
+    if b_r or n_r:
+        b_m, n_m = b_r.get("mxu_frac"), n_r.get("mxu_frac")
+        roofline = {
+            "base_mxu_frac": b_m,
+            "new_mxu_frac": n_m,
+            "delta": round(n_m - b_m, 4) if b_m is not None and n_m is not None else None,
+            "base_bound": b_r.get("bound"),
+            "new_bound": n_r.get("bound"),
+        }
     return {
         "tool": "tracediff",
         "schema_version": DIFF_SCHEMA_VERSION,
@@ -391,6 +444,9 @@ def diff_attributions(
         "time_to_first_trial": ttft,
         "wall": wall,
         "memory": memory,
+        "bubbles": bubbles,
+        "staging": staging,
+        "roofline": roofline,
         "significant_regressions": [
             n for n in shared if phases[n]["direction"] == "regression"
         ],
@@ -510,6 +566,47 @@ def apply_gate(report: dict, tol: dict) -> dict:
                 f"device-memory watermark +{rel:.1%} exceeds the "
                 f"{budget:.0%} budget"
             )
+    # absolute intra-phase budgets (ISSUE 11): judged on the NEW side's
+    # own numbers, not deltas — the diff's base is only context here
+    if "idle_frac" in tol:
+        budget = float(tol["idle_frac"])
+        n_i = (report.get("bubbles") or {}).get("new_idle_frac")
+        if n_i is None:
+            # explicitly budgeted but unmeasurable: the lost-coverage
+            # rule (same as a budgeted phase vanishing)
+            violations.append(
+                "idle_frac budgeted but the new attribution carries no "
+                "bubble analysis (pre-round-8 embed, or a span-less stream)"
+            )
+        elif n_i > budget:
+            violations.append(
+                f"device-idle fraction {n_i:.1%} exceeds the {budget:.0%} "
+                "budget (bubble-bound: see the trace table's idle-by-cause row)"
+            )
+    if "min_overlap" in tol:
+        budget = float(tol["min_overlap"])
+        n_o = (report.get("staging") or {}).get("new_overlap_frac")
+        # None skips: a resident run stages nothing, so there is no
+        # transfer to hide and no overlap to fall below a floor
+        if n_o is not None and n_o < budget:
+            violations.append(
+                f"staging overlap {n_o:.1%} below the {budget:.0%} floor "
+                "(the double buffer stopped hiding the transfer)"
+            )
+    if "min_mxu_frac" in tol:
+        budget = float(tol["min_mxu_frac"])
+        n_m = (report.get("roofline") or {}).get("new_mxu_frac")
+        if n_m is None:
+            violations.append(
+                "min_mxu_frac budgeted but achieved TF/s or the platform "
+                "cap is unmeasured (traced FLOPs + --peak-tflops or a "
+                "calibrated device kind required)"
+            )
+        elif n_m < budget:
+            violations.append(
+                f"MXU utilization {n_m:.1%} of the platform cap is below "
+                f"the {budget:.0%} floor (the kernel gap widened)"
+            )
     gate = {"ok": not violations, "violations": violations, "tolerances": tol}
     report["gate"] = gate
     return gate
@@ -580,6 +677,29 @@ def render_text(rep: dict) -> str:
             f"  device-memory peak: {m['base_peak_bytes']} -> "
             f"{m['new_peak_bytes']} bytes ({_fmt_rel(m['rel'])})"
         )
+
+    def _fmt_frac(v):
+        return "-" if v is None else f"{v:.1%}"
+
+    if rep.get("bubbles"):
+        b = rep["bubbles"]
+        lines.append(
+            f"  idle fraction: {_fmt_frac(b['base_idle_frac'])} -> "
+            f"{_fmt_frac(b['new_idle_frac'])}"
+        )
+    if rep.get("staging"):
+        s = rep["staging"]
+        lines.append(
+            f"  staging overlap: {_fmt_frac(s['base_overlap_frac'])} -> "
+            f"{_fmt_frac(s['new_overlap_frac'])}"
+        )
+    if rep.get("roofline"):
+        r = rep["roofline"]
+        lines.append(
+            f"  roofline: {r['base_bound'] or '-'} -> {r['new_bound'] or '-'}"
+            f" (MXU {_fmt_frac(r['base_mxu_frac'])} -> "
+            f"{_fmt_frac(r['new_mxu_frac'])})"
+        )
     if rep["gate"] is not None:
         if rep["gate"]["ok"]:
             lines.append("  gate: OK")
@@ -590,7 +710,7 @@ def render_text(rep: dict) -> str:
     return "\n".join(lines)
 
 
-def diff_main(targets, json_out: bool, gate_path, error) -> int:
+def diff_main(targets, json_out: bool, gate_path, error, peak_tflops=None) -> int:
     """The ``trace --diff`` body (``error`` is parser.error-shaped:
     usage problems exit 2; unreadable/undiffable TARGETS are runtime
     failures, rc 1, matching plain ``trace``)."""
@@ -607,7 +727,7 @@ def diff_main(targets, json_out: bool, gate_path, error) -> int:
     sides = []
     for target in targets:
         try:
-            sides.append(load_attribution(target))
+            sides.append(load_attribution(target, peak_tflops=peak_tflops))
         except (OSError, ValueError) as e:
             print(f"{target}: {e}", file=sys.stderr)
             if json_out:
@@ -673,6 +793,12 @@ def validate_bench_record(rec) -> list:
                     if stat not in p:
                         problems.append(f"trace phase {name!r} missing {stat!r}")
                         break
+            # the round-8 intra-phase sections are OPTIONAL (committed
+            # BENCH_r01-r05 history and --no-trace records must keep
+            # validating forever), but when present they must be objects
+            for opt in ("bubbles", "staging", "roofline"):
+                if tr.get(opt) is not None and not isinstance(tr[opt], dict):
+                    problems.append(f"trace {opt!r} must be null or an object")
     mem = rec.get("device_memory")
     if mem is not None and (
         not isinstance(mem, dict) or "bytes_in_use" not in mem or "source" not in mem

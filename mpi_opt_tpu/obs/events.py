@@ -120,10 +120,24 @@ SPAN_ATTRS = frozenset(
         "workload",  # fused setup workload name
         "cache",  # compile: cold | persistent (listener)
         "during",  # compile: enclosing span name (listener)
+        "device",  # local device kind (setup; keys the roofline cap table)
         # device-memory watermark (obs/memory.py; set at exit)
         "mem_bytes",  # steady bytes_in_use at phase exit
         "mem_peak_bytes",  # peak/watermark bytes at phase exit
         "mem_src",  # accounting source: memory_stats | live_arrays
+        # staging-overlap accounting (train/staging.py; the engine's
+        # CUMULATIVE counters at emit time, so a run killed
+        # mid-generation still carries partial overlap evidence)
+        "overlap_s",  # hidden transfer seconds (stage_out/stage_wait)
+        "wait_s",  # un-hidden drain-block seconds (stage_out/stage_wait)
+        # bubble/roofline layer (obs/bubbles.py): synthesized into
+        # timeline-export args and budgeted by the diff gate — schema
+        # the same way emitted attrs are
+        "idle_gap_s",  # one device-idle gap's seconds (timeline idle track)
+        "cause",  # the gap's dominant attribution (compile/staging_wait/...)
+        "bound",  # verdict: compute-bound | transfer-bound | bubble-bound
+        "peak_tflops",  # platform cap the verdict was judged against
+        "mxu_frac",  # achieved TF/s over the platform cap
     }
 )
 

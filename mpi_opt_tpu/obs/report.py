@@ -29,10 +29,23 @@ Phase rows also carry per-span SELF statistics (mean/sd/p50/p95 of
 exclusive seconds — what ``trace --diff``'s noise model judges) and a
 device-memory watermark column where spans carried obs/memory.py attrs.
 
+Intra-phase attribution (ISSUE 11, obs/bubbles.py) rides in three more
+sections: ``bubbles`` (device-idle gaps between busy spans, attributed
+to compile / staging wait / journal / checkpoint / setup /
+unattributed, per rank), ``staging`` (the wave engine's
+overlap/wait/transfer accounting promoted from summary counters to
+per-run trace evidence), and ``roofline`` (achieved TF/s against a
+platform cap: compute-bound / transfer-bound / bubble-bound, per train
+launch and for the run; cap from ``--peak-tflops`` or the calibration
+table keyed by the setup span's device kind).
+
 ``--json`` prints one machine-readable object (the bench/CI surface);
-text mode renders the table. ``--diff BASE NEW [--gate TOL.json]``
-dispatches to obs/diff.py: two attributions become per-phase deltas
-with a significance verdict, and the gate turns them into an exit code.
+text mode renders the table. ``--timeline OUT.json`` additionally
+exports the merged streams as Chrome trace-event JSON
+(Perfetto-loadable; obs/timeline.py). ``--diff BASE NEW [--gate
+TOL.json]`` dispatches to obs/diff.py: two attributions become
+per-phase deltas with a significance verdict, and the gate turns them
+into an exit code.
 """
 
 from __future__ import annotations
@@ -270,9 +283,12 @@ def _stream_summary(label: str, records: list) -> Optional[dict]:
     }
 
 
-def attribute(streams: dict) -> dict:
+def attribute(streams: dict, peak_tflops=None) -> dict:
     """The full attribution over ``{label: records}`` streams, merged by
-    absolute ``ts``. Returns the ``--json`` object."""
+    absolute ``ts``. Returns the ``--json`` object. ``peak_tflops``
+    overrides the roofline's platform cap (default: the calibration
+    table keyed by the setup span's recorded device kind)."""
+    from mpi_opt_tpu.obs import bubbles as _bubbles
     merged = []
     stream_summaries = []
     for label in sorted(streams):
@@ -310,6 +326,12 @@ def attribute(streams: dict) -> dict:
         for s in stream_summaries
         if s["time_to_first_trial_s"] is not None
     ]
+    # intra-phase attribution (obs/bubbles.py): idle gaps, staging
+    # overlap, and the roofline verdict the diff gate budgets
+    bubbles_rep = _bubbles.analyze(spans)
+    staging_rep = _bubbles.staging_summary(spans)
+    peak, peak_src = _bubbles.resolve_peak(spans, peak_tflops)
+    roofline_rep = _bubbles.roofline(spans, bubbles_rep, staging_rep, peak, peak_src)
     return {
         "streams": stream_summaries,
         "records": len(merged),
@@ -322,15 +344,20 @@ def attribute(streams: dict) -> dict:
         "train": _train_throughput(spans),
         "time_to_first_trial_s": min((v for _l, v in ttft), default=None),
         "memory": _memory_summary(spans),
+        "bubbles": bubbles_rep,
+        "staging": staging_rep,
+        "roofline": roofline_rep,
         "tenants": per_tenant,
     }
 
 
-def bench_attribution(path: str) -> dict:
+def bench_attribution(path: str, peak_tflops=None) -> dict:
     """The compact attribution subset benches embed beside trials/s
     (bench.py and bench_all.py both consume THIS, so the record shape
-    cannot drift between the two harnesses)."""
-    rep = attribute({os.path.basename(path): load_stream(path)})
+    cannot drift between the two harnesses). ``peak_tflops`` feeds the
+    roofline — bench.py passes its MEASURED platform cap on TPU, the
+    strongest possible roof; elsewhere the calibration table applies."""
+    rep = attribute({os.path.basename(path): load_stream(path)}, peak_tflops=peak_tflops)
     return {
         k: rep.get(k)
         for k in (
@@ -341,6 +368,9 @@ def bench_attribution(path: str) -> dict:
             "train",
             "time_to_first_trial_s",
             "memory",
+            "bubbles",
+            "staging",
+            "roofline",
         )
     }
 
@@ -387,6 +417,17 @@ def _render_text(rep: dict) -> str:
             "compile span hit the in-process jit cache"
         )
     t = rep["train"]
+    roof = rep.get("roofline")
+    # launch ordinals repeat across ranks/tenants in a merged stream —
+    # annotate a throughput row only when its ordinal maps to exactly
+    # ONE roofline entry, else the row would wear an arbitrary rank's
+    # verdict (the --json per_launch list stays complete either way)
+    launch_bound: dict = {}
+    if roof is not None:
+        for e in roof["per_launch"]:
+            if e["launch"] is not None:
+                launch_bound.setdefault(e["launch"], []).append(e)
+    launch_bound = {k: v[0] for k, v in launch_bound.items() if len(v) == 1}
     if t is not None and t["tflops_per_sec"] is not None:
         lines.append(
             f"  train: {t['tflops_per_sec']} TF/s achieved "
@@ -394,10 +435,57 @@ def _render_text(rep: dict) -> str:
         )
         for e in t["per_launch"]:
             if e["launch"] is not None:
-                lines.append(
+                row = (
                     f"    launch {e['launch']}: {e['dur_s']}s, "
                     f"{e['tflops_per_sec']} TF/s"
                 )
+                v = launch_bound.get(e["launch"])
+                if v is not None:
+                    row += f", {v['bound']}"
+                    if v["mxu_frac"] is not None:
+                        row += f" ({round(100.0 * v['mxu_frac'], 1)}% of cap)"
+                lines.append(row)
+    stg = rep.get("staging")
+    if stg is not None:
+        pct = (
+            "-"
+            if stg["overlap_frac"] is None
+            else f"{round(100.0 * stg['overlap_frac'], 1)}%"
+        )
+        lines.append(
+            f"  staging: {stg['staged_bytes'] / 1e9:.3f} GB moved, transfer "
+            f"{stg['transfer_s']}s, hidden {stg['overlap_s']}s ({pct} overlap), "
+            f"wait {stg['wait_s']}s over {stg['drains']} drain(s)"
+        )
+    bub = rep.get("bubbles")
+    if bub is not None and bub["wall_s"]:
+        pct = (
+            "-"
+            if bub["idle_frac"] is None
+            else f"{round(100.0 * bub['idle_frac'], 1)}%"
+        )
+        lines.append(
+            f"  bubbles: {bub['idle_s']}s device-idle ({pct} of wall) over "
+            f"{bub['gaps']} gap(s), largest {bub['largest_gap_s']}s"
+        )
+        if bub["by_cause"]:
+            causes = ", ".join(
+                f"{c} {v}s"
+                for c, v in sorted(bub["by_cause"].items(), key=lambda kv: -kv[1])
+            )
+            lines.append(f"    idle by cause: {causes}")
+    if roof is not None:
+        if roof["mxu_frac"] is not None:
+            detail = (
+                f"{roof['tflops_per_sec']} TF/s = "
+                f"{round(100.0 * roof['mxu_frac'], 1)}% of "
+                f"{roof['peak_tflops']} TF/s cap [{roof['peak_source']}]"
+            )
+        elif roof["tflops_per_sec"] is not None:
+            detail = f"{roof['tflops_per_sec']} TF/s achieved, no platform cap (--peak-tflops)"
+        else:
+            detail = "no traced FLOPs"
+        lines.append(f"  roofline: {roof['bound']} ({detail})")
     if rep["time_to_first_trial_s"] is not None:
         lines.append(f"  time to first trial: {rep['time_to_first_trial_s']}s")
     mem = rep.get("memory")
@@ -453,6 +541,23 @@ def trace_main(argv=None) -> int:
     )
     p.add_argument("--json", action="store_true", help="machine-readable output")
     p.add_argument(
+        "--timeline",
+        default=None,
+        metavar="OUT.json",
+        help="also export the merged streams as Chrome trace-event JSON "
+        "(load in https://ui.perfetto.dev or chrome://tracing): per-rank "
+        "process rows, per-thread tracks, span attrs as args, plus a "
+        "'device idle' track rendering the bubble analysis",
+    )
+    p.add_argument(
+        "--peak-tflops",
+        type=float,
+        default=None,
+        help="platform matmul cap for the roofline verdict (TF/s); "
+        "default: the obs/bubbles.py calibration table keyed by the "
+        "device kind the setup span recorded",
+    )
+    p.add_argument(
         "--diff",
         action="store_true",
         help="compare two attributions (BASE NEW): per-phase deltas "
@@ -471,11 +576,20 @@ def trace_main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.gate and not args.diff:
         p.error("--gate requires --diff")
+    if args.timeline and args.diff:
+        p.error("--timeline renders ONE run's streams; it cannot combine "
+                "with --diff (export each side separately)")
+    if args.peak_tflops is not None and args.peak_tflops <= 0:
+        p.error(f"--peak-tflops must be > 0, got {args.peak_tflops}")
     if args.diff:
         from mpi_opt_tpu.obs.diff import diff_main
 
         return diff_main(
-            args.targets, json_out=args.json, gate_path=args.gate, error=p.error
+            args.targets,
+            json_out=args.json,
+            gate_path=args.gate,
+            error=p.error,
+            peak_tflops=args.peak_tflops,
         )
 
     streams: dict = {}
@@ -511,7 +625,20 @@ def trace_main(argv=None) -> int:
         if args.json:
             print(json.dumps({"streams": [], "records": 0, "phases": {}}))
         return rc
-    rep = attribute(streams)
+    rep = attribute(streams, peak_tflops=args.peak_tflops)
+    if args.timeline:
+        from mpi_opt_tpu.obs.timeline import write_timeline
+
+        try:
+            n = write_timeline(
+                streams, args.timeline, peak_tflops=args.peak_tflops, attribution=rep
+            )
+        except OSError as e:
+            print(f"--timeline {args.timeline}: {e}", file=sys.stderr)
+            rc = 1
+        else:
+            # stderr: --json's stdout must stay one machine-parseable object
+            print(f"timeline: {n} events -> {args.timeline}", file=sys.stderr)
     if args.json:
         print(json.dumps(rep))
     else:

@@ -117,6 +117,22 @@ def enabled() -> bool:
     return _SINK is not None
 
 
+def note_device(sp: dict) -> None:
+    """Attach the local device kind to an active span's attr dict (the
+    setup spans carry it so obs/bubbles.py can default the roofline's
+    platform cap from its calibration table without the operator
+    passing --peak-tflops). No-op untraced, never raises — a telemetry
+    attr must not kill the run."""
+    if _SINK is None:
+        return
+    try:
+        import jax
+
+        sp["device"] = str(jax.local_devices()[0].device_kind)
+    except Exception:
+        pass
+
+
 def current_phase() -> Optional[str]:
     """The calling thread's innermost active span name, else the most
     recently entered still-active span on any thread (best effort),

@@ -207,6 +207,10 @@ def _collect_status(spool: Spool) -> dict:
             # post-slice device-memory watermark (obs/memory.py via the
             # scheduler): what this tenant's residency costs the device
             "device_memory": s.get("device_memory"),
+            # cumulative device-idle fraction from the tenant's span
+            # stream (obs/bubbles.py; written per slice end under
+            # serve --trace) — the co-residency signal beside memory
+            "idle_frac": s.get("idle_frac"),
         }
         # an ACTIVE tenant surfaces what it is doing right now: the
         # phase from its heartbeat (fed by the active trace span) and
@@ -260,6 +264,8 @@ def status_main(argv) -> int:
             mem = j.get("device_memory") or {}
             if mem.get("peak_bytes"):
                 extra += f" mem={mem['peak_bytes'] / (1 << 20):.0f}MiB"
+            if j.get("idle_frac") is not None:
+                extra += f" idle={j['idle_frac']:.0%}"
         if j.get("state") == "running" and (
             j.get("phase") or j.get("slice_elapsed_s") is not None
         ):

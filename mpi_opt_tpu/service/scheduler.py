@@ -121,6 +121,13 @@ class SweepService:
         # the spool as the durable record — cache their status so the
         # loop's cost tracks LIVE tenants, not all-time spool history
         self._terminal_cache: dict = {}
+        # per-job incremental idle trackers (serve --trace): each slice
+        # end refreshes the tenant's idle_frac from its span stream, and
+        # re-parsing the whole file every slice would be O(n^2) over a
+        # resident tenant's lifetime — the tracker reads only the bytes
+        # appended since its last poll (obs/bubbles.StreamIdleTracker).
+        # Dropped when the job goes terminal.
+        self._idle_trackers: dict = {}
         # per-loop-iteration memos: the scheduling steps (_admit_pending,
         # _apply_queued_cancels, _pick_next, _all_quiet) each scan the
         # spool, and neither the tenants/ directory listing nor a live
@@ -259,6 +266,12 @@ class SweepService:
         if job_id in self._retired:
             return
         self._retired.add(job_id)
+        # the job's incremental idle tracker dies with it — EVERY
+        # terminal transition funnels through here (slice end, queue
+        # cancel, terminal-cache insertion), so a parked job cancelled
+        # at the queue cannot leak its interval lists for the server's
+        # lifetime
+        self._idle_trackers.pop(job_id, None)
         name = status.get("tenant", "default")
         self._usage[name] = max(
             0, self._usage.get(name, 0) - int(status.get("slices") or 0)
@@ -508,6 +521,27 @@ class SweepService:
             else:
                 scope = "post_slice"
             status["device_memory"] = dict(mem, scope=scope)
+        # per-tenant device-idle fraction (ISSUE 11): how much of the
+        # tenant's traced wall the device sat in bubbles — computed
+        # from the tenant's own span stream, cumulative across its
+        # slices so far, so it exists only under serve --trace. The
+        # admission/packing layer's other half beside device_memory:
+        # a high-idle tenant is the co-residency candidate. The
+        # tracker is incremental (only bytes appended since its last
+        # poll are parsed) so a resident tenant's status refresh stays
+        # O(slice), not O(stream); dropped when the job goes terminal.
+        if self.trace:
+            from mpi_opt_tpu.obs.bubbles import StreamIdleTracker
+
+            tracker = self._idle_trackers.get(t.job_id)
+            if tracker is None:
+                tracker = self._idle_trackers[t.job_id] = StreamIdleTracker(t.metrics)
+            idle = tracker.poll()
+            if idle is not None:
+                status["idle_frac"] = idle
+            # terminal cleanup happens in _retire_usage (the one funnel
+            # every terminal transition passes through, including the
+            # queue-cancel path that never reaches this slice-end code)
         t.write_status(status)
         self._wrote_status(t)
         name = status.get("tenant", "default")

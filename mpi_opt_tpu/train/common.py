@@ -36,7 +36,9 @@ def workload_arrays(workload, member_chunk: int = 0, mesh=None):
         # setup span: dataset load + upload + trainer build — the cold
         # pre-first-launch time the trace CLI must attribute (it is part
         # of time-to-first-trial, and invisible without a span)
-        with trace.span("setup", workload=getattr(workload, "name", None)):
+        with trace.span("setup", workload=getattr(workload, "name", None)) as sp:
+            # device kind keys the roofline's platform-cap calibration
+            trace.note_device(sp)
             d = workload.data()
             arrays = (
                 jnp.asarray(d["train_x"]),

@@ -126,6 +126,12 @@ class StagingEngine:
     - ``overlap_s`` = max(0, transfer_s - wait_s): the hidden part. A
       healthy wave schedule has overlap_s ~ transfer_s and wait_s ~ the
       final wave's fetch only.
+
+    The cumulative ``overlap_s``/``wait_s`` values also ride on every
+    ``stage_out``/``stage_wait`` span as attrs (ISSUE 11), so a traced
+    run carries its overlap evidence in the stream itself — including
+    a wave run killed mid-generation, whose summary counters never
+    reach a result dict.
     """
 
     def __init__(self):
@@ -178,6 +184,16 @@ class StagingEngine:
                         self.staged_bytes += n_bytes
                         self.transfers += 1
                         n = self.transfers
+                        # the engine's CUMULATIVE overlap accounting on
+                        # every transfer span (ISSUE 11): a wave run
+                        # killed mid-generation still carries partial
+                        # overlap evidence in its trace — the summary
+                        # counters alone die with the process. This
+                        # job's own elapsed rides in because transfer_s
+                        # is only folded in by the finally below.
+                        done_s = self.transfer_s + (time.perf_counter() - t0)
+                        sp["wait_s"] = round(self.wait_s, 6)
+                        sp["overlap_s"] = round(max(0.0, done_s - self.wait_s), 6)
                     # per-transfer liveness: the main thread parks in
                     # drain() at generation boundaries, so without beats
                     # from HERE a hung host<->device stage (dead tunnel,
@@ -225,11 +241,17 @@ class StagingEngine:
         from mpi_opt_tpu.obs import trace
 
         t0 = time.perf_counter()
-        with trace.span("stage_wait"):
+        with trace.span("stage_wait") as sp:
             with self._idle:
                 while self._pending:
                     self._idle.wait(timeout=0.5)
                 self.wait_s += time.perf_counter() - t0
+                # at a drain every enqueued transfer has completed, so
+                # these are the engine's EXACT cumulative numbers — the
+                # per-generation overlap evidence the trace layer
+                # promotes into attribution (obs/bubbles.py)
+                sp["wait_s"] = round(self.wait_s, 6)
+                sp["overlap_s"] = round(self.overlap_s, 6)
                 if self._errors:
                     raise self._errors[0]
 

@@ -15,6 +15,7 @@
 #        T1_SKIP_SERVICE_DRILL=1 probes/tier1.sh # skip the sweep-service drill
 #        T1_SKIP_TRACE_DRILL=1 probes/tier1.sh # skip the span-trace drill
 #        T1_SKIP_PERFDIFF_DRILL=1 probes/tier1.sh # skip the trace-diff gate drill
+#        T1_SKIP_TIMELINE_DRILL=1 probes/tier1.sh # skip the timeline/bubble drill
 #        T1_SKIP_LINT_DRILL=1 probes/tier1.sh # skip the sweeplint drill
 set -o pipefail
 cd "$(dirname "$0")/.."
@@ -269,6 +270,51 @@ PYEOF
         echo "PERFDIFF_DRILL=pass"
     else
         echo "PERFDIFF_DRILL=FAIL"
+        rc=$(( rc == 0 ? 1 : rc ))
+    fi
+fi
+
+# -- timeline/bubble drill (intra-phase observability, obs/timeline+bubbles) --
+# A traced wave-scheduled fused sweep (staging engine active, so
+# overlap evidence exists) exported with `trace --timeline`: the JSON
+# must validate against the trace-event schema (the same validator the
+# tier-1 test runs — Perfetto-loadable structure), every span must land
+# as an X event, and the bubble analysis must obey its accounting
+# invariant: busy + idle == wall (per rank, summed) within tolerance.
+if [ -z "$T1_SKIP_TIMELINE_DRILL" ]; then
+    tl_rc=0
+    TL=$(mktemp -d /tmp/_t1_tline.XXXXXX)
+    timeout -k 10 180 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        --workload fashion_mlp --algorithm pbt --fused --no-mesh \
+        --population 4 --generations 2 --steps-per-generation 2 \
+        --wave-size 2 --seed 0 \
+        --metrics-file "$TL/m.jsonl" --trace >/dev/null 2>&1 || tl_rc=1
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        trace "$TL/m.jsonl" --timeline "$TL/tl.json" --json \
+        >"$TL/trace.json" 2>/dev/null || tl_rc=1
+    python - "$TL/tl.json" "$TL/trace.json" <<'PYEOF' || tl_rc=1
+import json, sys
+from mpi_opt_tpu.obs.timeline import validate_timeline
+doc = json.load(open(sys.argv[1]))
+problems = validate_timeline(doc)
+assert problems == [], problems
+rep = json.load(open(sys.argv[2]))
+xs = [e for e in doc["traceEvents"] if e["ph"] == "X" and e.get("cat") == "span"]
+assert len(xs) == rep["span_records"], (len(xs), rep["span_records"])
+bub = rep["bubbles"]
+# the accounting invariant: busy + idle == wall (small epsilon only)
+assert abs(bub["busy_s"] + bub["idle_s"] - bub["wall_s"]) < 0.05, bub
+assert bub["idle_frac"] is not None
+# the wave sweep staged, so overlap evidence must be in the stream
+stg = rep["staging"]
+assert stg is not None and stg["drains"] >= 2, stg
+assert rep["roofline"]["bound"] in ("compute-bound", "transfer-bound", "bubble-bound")
+PYEOF
+    rm -rf "$TL"
+    if [ $tl_rc -eq 0 ]; then
+        echo "TIMELINE_DRILL=pass"
+    else
+        echo "TIMELINE_DRILL=FAIL"
         rc=$(( rc == 0 ? 1 : rc ))
     fi
 fi

@@ -103,6 +103,32 @@ def test_v2_record_validates_and_requires_new_keys():
     )
 
 
+def test_v2_trace_intra_phase_sections_are_optional():
+    """ISSUE 11: bubbles/staging/roofline ride in round-8+ embedded
+    attributions, but they are OPTIONAL — a round-7 embed (or --no-trace
+    record) without them must keep validating forever, and a present
+    section must be an object."""
+    # absent: valid (the round-7 shape)
+    assert validate_bench_record(_v2(trace=_attribution(1.0))) == []
+    # present and well-shaped: valid
+    tr = _attribution(1.0)
+    tr["bubbles"] = {"idle_frac": 0.1, "idle_s": 0.5, "by_cause": {"compile": 0.5}}
+    tr["staging"] = {"overlap_frac": 0.76, "overlap_s": 3.0, "wait_s": 1.0}
+    tr["roofline"] = {"bound": "compute-bound", "mxu_frac": 0.21, "peak_tflops": 157.0}
+    assert validate_bench_record(_v2(trace=tr)) == []
+    # explicit null: valid (an untraced-memory environment)
+    tr2 = _attribution(1.0)
+    tr2["bubbles"] = tr2["staging"] = tr2["roofline"] = None
+    assert validate_bench_record(_v2(trace=tr2)) == []
+    # present but mis-typed: flagged
+    for key in ("bubbles", "staging", "roofline"):
+        bad = _attribution(1.0)
+        bad[key] = "not an object"
+        assert any(
+            key in p for p in validate_bench_record(_v2(trace=bad))
+        ), key
+
+
 def test_committed_bench_history_stays_valid():
     """BENCH_r01-r05 predate the schema_version field: they must
     validate as the legacy shape forever (the trajectory's early rounds

@@ -328,6 +328,9 @@ def test_trace_cli_json_schema(tmp_path, capsys):
         "compile",
         "train",
         "time_to_first_trial_s",
+        "bubbles",
+        "staging",
+        "roofline",
         "tenants",
     ):
         assert key in rep, key
@@ -513,3 +516,12 @@ def test_traced_fused_sweep_end_to_end(tmp_path, capsys):
     # XLA:CPU cost analysis is available in this container, so the
     # train spans carry FLOPs and achieved TF/s is a number
     assert rep["train"] is not None and rep["train"]["tflops_per_sec"] > 0
+    # the intra-phase sections (ISSUE 11) ride in every attribution:
+    # bubble totals obey busy + idle == wall, and the roofline verdict
+    # is one of the three bound classes
+    bub = rep["bubbles"]
+    assert bub is not None and bub["idle_frac"] is not None
+    assert bub["busy_s"] + bub["idle_s"] == pytest.approx(bub["wall_s"], abs=0.01)
+    assert rep["roofline"]["bound"] in (
+        "compute-bound", "transfer-bound", "bubble-bound",
+    )

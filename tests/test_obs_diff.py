@@ -259,6 +259,10 @@ def test_gate_tolerance_typos_are_usage_errors(tmp_path):
         validate_tolerances({"default": [0.1]})
     with pytest.raises(ValueError, match="must be a number"):
         validate_tolerances({"default": True})
+    # the ISSUE-11 absolute budgets are legal keys and type-checked
+    validate_tolerances({"idle_frac": 0.25, "min_overlap": 0.6, "min_mxu_frac": 0.15})
+    with pytest.raises(ValueError, match="must be a number"):
+        validate_tolerances({"idle_frac": "high"})
     with pytest.raises(ValueError, match="list of span names"):
         validate_tolerances({"ignore": "train"})
     with pytest.raises(ValueError, match="boolean"):
@@ -361,12 +365,18 @@ def test_diff_json_schema(tmp_path, capsys):
         "time_to_first_trial",
         "wall",
         "memory",
+        "bubbles",
+        "staging",
+        "roofline",
         "significant_regressions",
         "significant_improvements",
         "gate",
     ):
         assert key in rep, key
     assert rep["tool"] == "tracediff"
+    # both sides are round-8 streams, so the intra-phase sections carry
+    # numbers (a self-diff's idle fractions are identical)
+    assert rep["bubbles"]["base_idle_frac"] == rep["bubbles"]["new_idle_frac"]
     d = rep["phases"]["train"]
     for key in (
         "base",

@@ -304,6 +304,25 @@ def test_three_tenants_slice_interleaved_ledgers_identical_to_solo(
 # -- compiled-program reuse ------------------------------------------------
 
 
+def test_traced_slice_writes_idle_frac(tmp_path):
+    """serve --trace (ISSUE 11): every slice end writes the tenant's
+    cumulative device-idle fraction — computed from the tenant's own
+    span stream by obs/bubbles.py — into status.json beside the memory
+    watermark, so the admission layer can spot the co-residency
+    candidates (high-idle tenants) without replaying traces."""
+    spool = Spool(str(tmp_path))
+    j = spool.submit(FUSED, tenant="alice")
+    assert _service(tmp_path, slice_boundaries=2, trace=True).serve() == 0
+    st = spool.tenant(j).status
+    assert st["state"] == tstates.DONE
+    assert isinstance(st.get("idle_frac"), float), st.get("idle_frac")
+    assert 0.0 <= st["idle_frac"] <= 1.0
+    # untraced server: the field never appears (no stream to judge)
+    j2 = spool.submit(FUSED, tenant="bob")
+    assert _service(tmp_path, slice_boundaries=2).serve() == 0
+    assert "idle_frac" not in spool.tenant(j2).status
+
+
 def test_program_cache_hit_for_shape_matching_second_tenant(tmp_path):
     """Tenant B submits the same (workload, pop-shape, chunking) as A:
     B's first slice reports a program-cache HIT (its trainers/programs
