@@ -49,6 +49,7 @@ def all_checkers():
     )
     from mpi_opt_tpu.analysis.checkers_exit import ExitCodeChecker
     from mpi_opt_tpu.analysis.checkers_jax import HostSyncChecker, KeyReuseChecker
+    from mpi_opt_tpu.analysis.checkers_lease import LeaseWriteChecker
     from mpi_opt_tpu.analysis.checkers_registry import EventRegistryChecker
 
     return [
@@ -61,4 +62,5 @@ def all_checkers():
         KeyReuseChecker(),
         HostSyncChecker(),
         EventRegistryChecker(),
+        LeaseWriteChecker(),
     ]

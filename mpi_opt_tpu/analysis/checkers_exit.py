@@ -4,7 +4,7 @@ Three layers classify the sweep's exit codes (CLI producing them,
 launch supervisor restart policy, service tenant state machine); PR 7
 consolidated the literals into ``utils/exitcodes.py`` precisely because
 keeping bare 75s/65s in sync across them failed twice in review. The
-invariant: a REGISTERED code (0/1/2/65/75) appears as an integer
+invariant: a REGISTERED code (0/1/2/65/69/75) appears as an integer
 literal only in ``utils/exitcodes.py`` — everywhere else it must be the
 named constant, both in exit calls (``sys.exit(75)``,
 ``SystemExit(65)``, ``os._exit(75)``) and in classification comparisons
@@ -24,7 +24,7 @@ from mpi_opt_tpu.analysis.core import Checker, FileContext
 #: deliberately NOT flagged: `return 0`/`exit(1)` literals are the
 #: universal unix idiom and carry no cross-layer protocol meaning the
 #: named constants exist to protect (65/75/2 do).
-CONTRACT_CODES = frozenset({2, 65, 75})
+CONTRACT_CODES = frozenset({2, 65, 69, 75})
 
 _EXIT_CALLEES = frozenset({"exit", "_exit", "SystemExit"})
 

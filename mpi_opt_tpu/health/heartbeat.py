@@ -79,11 +79,47 @@ class Heartbeat:
                     "watching this file will treat the rank as unwatched",
                     stacklevel=2,
                 )
+            _notify_listener(rec)
             return None
+        _notify_listener(rec)
         return rec
 
 
 _ACTIVE: Optional[Heartbeat] = None
+
+#: process-wide beat listener (see set_beat_listener): piggybacks on
+#: every unit of real progress, whichever thread produced it
+_LISTENER = None
+
+
+def set_beat_listener(fn) -> None:
+    """Install a callback fired after EVERY beat of every heartbeat in
+    this process (the beat record is passed; it may be None when the
+    write failed — progress still happened).
+
+    This is the lease-refresh ride-along (service/leases.Refresher):
+    beats mark real progress at sub-launch granularity, which is
+    exactly the cadence a lease deadline should be re-extended at — no
+    new timer thread, no extra clock. The listener must be cheap and
+    must never raise (it runs on the sweep's hot host path and inside
+    the staging engine's transfer thread); exceptions are contained
+    here because a broken listener must not kill the sweep its
+    heartbeat reports on."""
+    global _LISTENER
+    _LISTENER = fn
+
+
+def clear_beat_listener() -> None:
+    set_beat_listener(None)
+
+
+def _notify_listener(rec) -> None:
+    if _LISTENER is None:
+        return
+    try:
+        _LISTENER(rec)
+    except Exception:
+        pass  # contained: a listener bug must not kill the sweep
 
 
 def configure(path: str) -> Heartbeat:

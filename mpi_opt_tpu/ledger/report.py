@@ -91,6 +91,12 @@ def _service_status(path: str) -> Optional[dict]:
         # post-slice device-memory watermark (obs/memory.py via the
         # scheduler's status write)
         "device_memory": s.get("device_memory"),
+        # fleet fields (ISSUE 12): which server ran the last slice and
+        # how many times a dead peer's lease was taken over — a ledger
+        # that changed hands mid-sweep is still record-identical to a
+        # solo run, and the report should say the handoff happened
+        "server": s.get("server"),
+        "takeovers": s.get("takeovers"),
     }
     # an ACTIVE tenant also reports what it is doing right now (phase
     # from its heartbeat's active-span field + current slice elapsed) —
@@ -206,11 +212,16 @@ def _render_text(rep: dict) -> str:
                 f" phase={s.get('phase')}"
                 f" slice_elapsed={s.get('slice_elapsed_s')}s"
             )
+        fleet = ""
+        if s.get("server"):
+            fleet = f" server={s['server']}"
+        if s.get("takeovers"):
+            fleet += f" takeovers={s['takeovers']}"
         lines.append(
             f"  service: tenant={s.get('tenant')} job={s.get('job')} "
             f"state={s.get('state')} slices={s.get('slices')} "
             f"preemptions={s.get('preemptions')} "
-            f"cache={pc.get('hits', 0)}h/{pc.get('misses', 0)}m" + live
+            f"cache={pc.get('hits', 0)}h/{pc.get('misses', 0)}m" + fleet + live
         )
     if rep["torn_tail_dropped"]:
         lines.append("  note: 1 torn tail line dropped (crash mid-append)")

@@ -58,8 +58,16 @@ EVENTS = frozenset(
         "slice_end",
         "tenant_admit",
         "tenant_cancelled",
-        "tenant_recovered",
         "tenant_reject",
+        # fleet federation (service/leases.py + scheduler):
+        # tenant_takeover = an orphaned job claimed from a dead/expired
+        # peer's lease; slice_fenced = a zombie slice's end-of-slice
+        # writes refused (token mismatch); server_usurped = this
+        # server's id was re-registered while it was presumed dead and
+        # it stepped down (exit EX_UNAVAILABLE)
+        "tenant_takeover",
+        "slice_fenced",
+        "server_usurped",
         # span tracing (obs/trace.py): one event kind, span names below
         "span",
     }

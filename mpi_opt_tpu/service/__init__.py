@@ -11,6 +11,8 @@ Pieces:
 
 - ``spool``    — filesystem queue + control plane (no network needed)
 - ``tenants``  — the per-job state machine over exit-code outcomes
+- ``leases``   — fleet federation: per-job lease claims with fencing
+  tokens, heartbeat-ridden TTL refresh, crash-safe takeover
 - ``programs`` — compiled-program reuse across shape-matching tenants
 - ``scheduler``— the server loop: admit, fair-share pick, slice, park
 - ``client``   — ``submit`` / ``status`` / ``cancel`` / ``drain``

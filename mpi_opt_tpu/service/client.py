@@ -71,6 +71,28 @@ def serve_main(argv) -> int:
         "excess jobs wait in the queue",
     )
     p.add_argument(
+        "--server-id",
+        default=None,
+        metavar="ID",
+        help="this server's fleet identity (registered under "
+        "servers/<ID>.json; a live same-id collision is refused). Give "
+        "each server of a multi-server spool a distinct id; the default "
+        "id deliberately collides, preserving one-server-per-spool",
+    )
+    p.add_argument(
+        "--lease-ttl",
+        type=float,
+        default=600.0,
+        metavar="S",
+        help="per-job lease deadline: a job whose lease went this long "
+        "without a refresh may be taken over by any live server (a "
+        "provably dead same-host holder is taken over immediately, so "
+        "a generous TTL costs only cross-host takeover latency). Size "
+        "it above the longest gap between heartbeat beats — in practice "
+        "the cold-compile window, 140-210 s measured, which is why the "
+        "default is 600 (see README: TTL tuning)",
+    )
+    p.add_argument(
         "--poll-seconds", type=float, default=0.5, help="idle spool poll interval"
     )
     p.add_argument(
@@ -109,6 +131,18 @@ def serve_main(argv) -> int:
         p.error(
             f"--max-active-per-tenant must be >= 1, got {args.max_active_per_tenant}"
         )
+    if args.lease_ttl <= 0:
+        p.error(f"--lease-ttl must be > 0, got {args.lease_ttl}")
+    if args.server_id is not None and (
+        not args.server_id
+        or not all(c.isalnum() or c in "._-" for c in args.server_id)
+    ):
+        # the id becomes a filename under servers/ — a separator or
+        # shell glob in it would scatter registrations around the tree
+        p.error(
+            f"--server-id {args.server_id!r} must be non-empty "
+            "letters/digits/._- only"
+        )
     # device bring-up happens HERE, once, before any tenant runs, via
     # the SAME validate-and-pin helper the flat CLI uses (a serve-local
     # copy once dropped its --local-devices >= 1 guard and turned a
@@ -129,6 +163,8 @@ def serve_main(argv) -> int:
         drain_on_empty=args.drain_on_empty,
         metrics_stream=sys.stdout,
         trace=args.trace,
+        server_id=args.server_id,
+        lease_ttl=args.lease_ttl,
     )
     try:
         return service.serve()
@@ -172,8 +208,47 @@ def submit_main(argv) -> int:
     return 0
 
 
+def _collect_servers(records: list, spool: Spool, owners: dict) -> list:
+    """The fleet table: one row per registration (``records`` is ONE
+    ``read_servers()`` scan, shared with the aggregate header — status
+    runs against the contended shared filesystems fleets live on, so
+    the directory is listed once, not per consumer), live or dead (a
+    dead row is evidence — its jobs are the takeover candidates).
+    ``owners`` maps server_id -> list of job ids whose LIVE lease
+    names it (computed by the caller from the lease scan, so the
+    tenant walk happens once too)."""
+    out = []
+    now = time.time()
+    for rec in records:
+        sid = rec.get("server_id")
+        row = {
+            "server_id": sid,
+            "pid": rec.get("pid"),
+            "pid_start": rec.get("pid_start"),
+            "host": rec.get("host"),
+            "alive": spool.server_alive(rec),
+            "lease_ttl": rec.get("lease_ttl"),
+            "takeovers": rec.get("takeovers"),
+            "slices": rec.get("slices"),
+            "tenants": owners.get(sid, []),
+        }
+        try:
+            row["refreshed_age_s"] = round(max(0.0, now - float(rec["ts"])), 3)
+        except (KeyError, TypeError, ValueError):
+            row["refreshed_age_s"] = None
+        out.append(row)
+    return out
+
+
 def _collect_status(spool: Spool) -> dict:
-    server = spool.read_server()
+    from mpi_opt_tpu.service import leases
+
+    server_records = spool.read_servers()
+    server = (
+        max(server_records, key=lambda r: float(r.get("ts") or 0.0))
+        if server_records
+        else None
+    )
     jobs = []
     for qpath in spool.pending_jobs():
         from mpi_opt_tpu.service.spool import _read_json
@@ -192,8 +267,24 @@ def _collect_status(spool: Spool) -> dict:
         )
     from mpi_opt_tpu.service.spool import live_phase
 
+    owners: dict = {}
     for t in spool.tenants():
         s = t.status
+        # the job's lease, surfaced raw-ish: who holds it and whether
+        # the hold is still live — `status` is the operator's first
+        # stop when deciding if a "running" job is real work or an
+        # orphan a surviving server is about to take over
+        lease = leases.read_lease(t.lease)
+        lease_view = None
+        if lease is not None:
+            live = not leases.expired(lease)
+            lease_view = {
+                "server_id": lease.get("server_id"),
+                "live": live,
+                "expires_ts": lease.get("expires_ts"),
+            }
+            if live:
+                owners.setdefault(lease.get("server_id"), []).append(t.job_id)
         job = {
             "job": t.job_id,
             "tenant": s.get("tenant", "default"),
@@ -211,6 +302,11 @@ def _collect_status(spool: Spool) -> dict:
             # stream (obs/bubbles.py; written per slice end under
             # serve --trace) — the co-residency signal beside memory
             "idle_frac": s.get("idle_frac"),
+            # fleet fields: which server ran the last slice, how many
+            # times the job changed hands, and the current lease hold
+            "server": s.get("server"),
+            "takeovers": s.get("takeovers"),
+            "lease": lease_view,
         }
         # an ACTIVE tenant surfaces what it is doing right now: the
         # phase from its heartbeat (fed by the active trace span) and
@@ -219,12 +315,17 @@ def _collect_status(spool: Spool) -> dict:
         if live is not None:
             job.update(live)
         jobs.append(job)
+    servers = _collect_servers(server_records, spool, owners)
     return {
         "state_dir": spool.state_dir,
+        # aggregate single-server view kept for scripts that predate
+        # the fleet: alive = ANY live registration, fields from the
+        # most recently refreshed one
         "server": {
-            "alive": spool.server_alive(),
+            "alive": any(s["alive"] for s in servers),
             **({} if server is None else server),
         },
+        "servers": servers,
         "draining": spool.drain_requested(),
         "jobs": jobs,
     }
@@ -242,13 +343,36 @@ def status_main(argv) -> int:
     if args.json:
         print(json.dumps(info))
         return 0
-    alive = "up" if info["server"]["alive"] else "down"
-    pid = info["server"].get("pid")
+    servers = info["servers"]
+    n_up = sum(1 for s in servers if s["alive"])
+    if len(servers) > 1 or (servers and not servers[0]["alive"]):
+        head = f"{n_up}/{len(servers)} servers up"
+    else:
+        head = "server up" if n_up else "server down"
     print(
-        f"service {info['state_dir']}: server {alive}"
-        + (f" (pid {pid})" if pid else "")
+        f"service {info['state_dir']}: {head}"
         + (" [draining]" if info["draining"] else "")
     )
+    # the fleet table: per-server liveness (registration freshness +
+    # pid/proc-start identity), owned jobs, and takeover counts — the
+    # operator's answer to "which host is doing what, and is the dead
+    # one's work safe" without grepping server logs
+    for s in servers:
+        state = "up" if s["alive"] else "DEAD"
+        age = s.get("refreshed_age_s")
+        owned = s.get("tenants") or []
+        line = (
+            f"  server {s['server_id']}  {state}  "
+            f"pid={s.get('pid')}@{s.get('host')}"
+            f" start={s.get('pid_start')}"
+        )
+        if age is not None:
+            line += f" refreshed={age}s ago"
+        if s.get("takeovers"):
+            line += f" takeovers={s['takeovers']}"
+        if owned:
+            line += f" owns={','.join(owned)}"
+        print(line)
     if not info["jobs"]:
         print("  no jobs")
     for j in info["jobs"]:
@@ -266,6 +390,15 @@ def status_main(argv) -> int:
                 extra += f" mem={mem['peak_bytes'] / (1 << 20):.0f}MiB"
             if j.get("idle_frac") is not None:
                 extra += f" idle={j['idle_frac']:.0%}"
+            if j.get("server"):
+                extra += f" on={j['server']}"
+            if j.get("takeovers"):
+                extra += f" takeovers={j['takeovers']}"
+        lease = j.get("lease")
+        if j.get("state") == "running" and lease is not None and not lease["live"]:
+            # the fleet's load-bearing warning: "running" with a dead
+            # hold is an orphan awaiting takeover, not live work
+            extra += f" lease=EXPIRED (was {lease.get('server_id')})"
         if j.get("state") == "running" and (
             j.get("phase") or j.get("slice_elapsed_s") is not None
         ):

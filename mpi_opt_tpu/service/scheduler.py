@@ -31,9 +31,22 @@ boundary (the tenant's own guard handles the signal; the server reads
 death from its own slice expiry), parks it, and exits 0 — the spool on
 disk IS the queue checkpoint, so a restarted server resumes every
 in-flight tenant via the verified-snapshot + journal-prefix machinery.
-A SIGKILLed server leaves a tenant marked ``running``; restart demotes
-it to ``parked`` (stale-server detection) and the same resume path
-recovers it.
+A SIGKILLed server leaves a tenant marked ``running``; any surviving
+fleet peer (or a restart) claims its expired/dead-holder lease and the
+same resume path recovers it.
+
+Fleet federation (ISSUE 12): N servers — one per host/chip — share one
+spool. Each registers under ``servers/<--server-id>.json`` (a live
+same-id collision is refused; the default id preserves the old
+one-server-per-spool behavior), and per-JOB admission is arbitrated by
+``tenants/<job>/lease.json`` (service/leases.py): ``_pick_next``
+acquires the pick's lease (a peer's live lease just skips the job), a
+lease-refresh keeper rides the tenant's heartbeat path during the
+slice, and every end-of-slice metadata write is fenced on the lease
+token so a taken-over zombie's late writes are refused rather than
+racing the new owner. Takeover is not a new recovery path: it is the
+ordinary ``--resume`` against whatever the dead server's last boundary
+flushed.
 """
 
 from __future__ import annotations
@@ -41,14 +54,16 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import threading
 import time
 import traceback
 from typing import Callable, Optional
 
 from mpi_opt_tpu.obs import memory as obs_memory
-from mpi_opt_tpu.service import tenants as tstates
+from mpi_opt_tpu.service import leases, tenants as tstates
 from mpi_opt_tpu.service.programs import ProgramCache
 from mpi_opt_tpu.service.spool import Spool, TenantDir
+from mpi_opt_tpu.utils.exitcodes import EX_UNAVAILABLE
 
 
 def _read_summary(log_path: str, start: int) -> Optional[dict]:
@@ -88,6 +103,8 @@ class SweepService:
         on_boundary: Optional[Callable] = None,
         on_slice_end: Optional[Callable] = None,
         trace: bool = False,
+        server_id: Optional[str] = None,
+        lease_ttl: float = 600.0,
     ):
         if slice_boundaries < 1:
             raise ValueError(f"slice_boundaries must be >= 1, got {slice_boundaries}")
@@ -95,7 +112,27 @@ class SweepService:
             raise ValueError(
                 f"max_active_per_tenant must be >= 1, got {max_active_per_tenant}"
             )
+        if lease_ttl <= 0:
+            raise ValueError(f"lease_ttl must be > 0, got {lease_ttl}")
         self.spool = Spool(state_dir)
+        # fleet identity: the default id COLLIDES on purpose (two
+        # default-id servers refuse each other, preserving the PR 7
+        # one-server-per-spool behavior); federation is opted into with
+        # distinct --server-id values. pid + /proc start time is the
+        # fencing identity every lease this server takes will carry.
+        self.server_id = server_id or Spool.DEFAULT_SERVER_ID
+        self.lease_ttl = float(lease_ttl)
+        self.ident = leases.ServerIdentity.local(self.server_id)
+        self._takeovers = 0
+        # server-registration heartbeat throttle (monotonic): refreshed
+        # from the serve loop between slices AND from the active
+        # tenant's beats during one (a long slice must not let the
+        # registration go stale — a remote peer judges us by its ts),
+        # capped so an enormous TTL still keeps the fleet view usable
+        self._server_refresh_every = min(self.lease_ttl / 3.0, 10.0)
+        self._server_refresh_next = 0.0
+        self._usurped = False
+        self._reg_lock = threading.Lock()
         self.slice_boundaries = slice_boundaries
         self.slice_seconds = slice_seconds
         self.max_active_per_tenant = max_active_per_tenant
@@ -225,23 +262,16 @@ class SweepService:
             except SpoolError as e:
                 self.metrics.log("tenant_reject", error=str(e))
                 continue
+            except OSError as e:
+                # persistent I/O failure mid-admission: the queue file
+                # (or a half-built tenant dir) survives on disk, so the
+                # next loop iteration retries — one sick write must not
+                # kill the server every other tenant is riding on
+                self.metrics.log("tenant_reject", error=f"admission I/O: {e}")
+                continue
             counts[name] = counts.get(name, 0) + 1
             self._tenants_memo = None  # a new tenant dir exists now
             self.metrics.log("tenant_admit", job=t.job_id, tenant=name)
-
-    def _recover_stale_running(self) -> None:
-        """A tenant stuck in ``running`` with no live server behind it
-        is the SIGKILL shape: demote to parked — its durable state is
-        whatever the last boundary flushed, exactly what --resume's
-        verified-snapshot + journal-prefix machinery expects."""
-        for t in self.spool.tenants():
-            s = t.status
-            if s.get("state") == tstates.RUNNING:
-                t.write_status(
-                    dict(s, state=tstates.PARKED, note="recovered from dead server")
-                )
-                self._wrote_status(t)
-                self.metrics.log("tenant_recovered", job=t.job_id)
 
     def _apply_queued_cancels(self) -> None:
         for t in self._tenants():
@@ -250,10 +280,28 @@ class SweepService:
             # cancel_requested() is a stat — keep per-iteration syscalls
             # proportional to LIVE tenants, not all-time spool history
             if s.get("state") in tstates.RUNNABLE and t.cancel_requested():
-                t.write_status(dict(s, state=tstates.CANCELLED))
-                self._wrote_status(t)
-                self._retire_usage(s)  # a parked job may have slices
-                self.metrics.log("tenant_cancelled", job=t.job_id, at="queue")
+                # the terminal write is lease-guarded: a peer that just
+                # picked this tenant (parked -> about to run) holds the
+                # lease, and our CANCELLED write would race its RUNNING
+                # one — it will honor the cancel flag at its own first
+                # boundary instead
+                try:
+                    lease = leases.acquire(t.lease, self.ident, self.lease_ttl)
+                except OSError:
+                    continue  # sick lease I/O: retry next iteration
+                if lease is None:
+                    continue
+                # re-read under OUR lease: a peer may have run (or even
+                # finished) a slice between our status snapshot and the
+                # acquisition — writing the stale snapshot would erase
+                # its slice accounting
+                s = t.status
+                if s.get("state") in tstates.RUNNABLE:
+                    t.write_status(dict(s, state=tstates.CANCELLED))
+                    self._wrote_status(t)
+                    self._retire_usage(s)  # a parked job may have slices
+                    self.metrics.log("tenant_cancelled", job=t.job_id, at="queue")
+                leases.release(t.lease, lease)
 
     def _retire_usage(self, status: dict) -> None:
         """Remove a newly-terminal job's slice count from the in-session
@@ -277,23 +325,77 @@ class SweepService:
             0, self._usage.get(name, 0) - int(status.get("slices") or 0)
         )
 
-    def _pick_next(self) -> Optional[TenantDir]:
-        """Fair share: fewest-slices tenant name first, FIFO within."""
-        runnable = [
-            (t, s)
-            for t in self._tenants()
-            for s in (self._tenant_status(t),)
-            if s.get("state") in tstates.RUNNABLE
-        ]
-        if not runnable:
+    def _takeover_candidate(self, t: TenantDir, s: dict) -> Optional[dict]:
+        """Is this RUNNING tenant orphaned? Orphaned when its lease is
+        absent (a pre-lease spool, or a crash in the claim window — the
+        durable state is whatever the last boundary flushed) or expired
+        / held by a provably dead process (the SIGKILLed-server shape).
+        A RUNNING tenant with a live lease belongs to a working peer.
+        Returns the dead holder's lease record as evidence (``{}`` for
+        a lease-less orphan), or None when not a candidate — the
+        record is captured HERE because by acquisition time a racing
+        peer's steal may have the file mid-tomb (absent)."""
+        if s.get("state") != tstates.RUNNING:
             return None
-        runnable.sort(
-            key=lambda ts: (
-                self._usage.get(ts[1].get("tenant", "default"), 0),
-                ts[0].job_id,
+        lease = leases.read_lease(t.lease)
+        if lease is None:
+            return {}
+        return lease if leases.expired(lease) else None
+
+    def _pick_next(self) -> Optional[tuple]:
+        """Fair share: fewest-slices tenant name first, FIFO within —
+        then ACQUIRE the pick's lease. Returns ``(tenant, lease,
+        takeover_from)`` or None. Acquisition is the fleet arbiter: a
+        candidate whose lease a peer wins is skipped (never blocked
+        on), so N servers sharing the spool settle every conflict at
+        the lease file, not in scheduler logic. ``takeover_from`` is
+        the dead holder's server id when the pick was an orphaned
+        RUNNING tenant (the takeover shape), else None."""
+        candidates = []
+        for t in self._tenants():
+            s = self._tenant_status(t)
+            if s.get("state") in tstates.RUNNABLE:
+                candidates.append((t, s, None))
+            else:
+                prior = self._takeover_candidate(t, s)
+                if prior is not None:
+                    candidates.append((t, s, prior))
+        candidates.sort(
+            key=lambda tsk: (
+                self._usage.get(tsk[1].get("tenant", "default"), 0),
+                tsk[0].job_id,
             )
         )
-        return runnable[0][0]
+        for t, _s0, prior in candidates:
+            try:
+                lease = leases.acquire(t.lease, self.ident, self.lease_ttl)
+            except OSError:
+                # persistently sick I/O on ONE lease file must not kill
+                # the server: skip the job this round, the next loop
+                # iteration (or a healthier peer) retries
+                continue
+            if lease is None:
+                continue  # a live peer holds (or just won) this job
+            # re-read under OUR lease — for EVERY pick, not just the
+            # takeover shape: a peer may have run the job to terminal
+            # (or applied a cancel) between our candidacy snapshot and
+            # the acquisition, and scheduling from the stale snapshot
+            # would resurrect a settled tenant
+            s = t.status
+            state = s.get("state")
+            if state in tstates.RUNNABLE:
+                return t, lease, None
+            if state == tstates.RUNNING:
+                # still the orphan shape (we hold its lease: no live
+                # peer does) — take it over
+                from_server = (
+                    (prior or {}).get("server_id")
+                    or s.get("server")
+                    or "unknown"
+                )
+                return t, lease, from_server
+            leases.release(t.lease, lease)  # settled while we raced
+        return None
 
     # -- the slice ---------------------------------------------------
 
@@ -320,12 +422,17 @@ class SweepService:
             argv += ["--metrics-file", t.metrics, "--trace"]
         return argv
 
-    def _run_slice(self, t: TenantDir) -> Optional[str]:
-        """One scheduling quantum on the device. Returns the REAL signal
-        name if one was delivered mid-slice (the server must drain), else
-        None."""
+    def _run_slice(
+        self, t: TenantDir, lease: dict, takeover_from: Optional[str] = None
+    ) -> Optional[str]:
+        """One scheduling quantum on the device, under a HELD lease
+        (the caller acquired it in ``_pick_next``). Returns the REAL
+        signal name if one was delivered mid-slice (the server must
+        drain), else None. Every tenant-metadata write below is fenced
+        on the lease token, and the lease is released on every exit
+        path we still own it on."""
         from mpi_opt_tpu.cli import main as cli_main
-        from mpi_opt_tpu.health import shutdown
+        from mpi_opt_tpu.health import heartbeat, shutdown
         from mpi_opt_tpu.service.spool import SpoolError
 
         # a real signal may land between the serve loop's shutdown check
@@ -334,6 +441,7 @@ class SweepService:
         # evidence — so the tenant would burn a full quantum before the
         # drain. Re-check now, before any tenant state changes.
         if shutdown.requested() or shutdown.delivered_signal():
+            leases.release(t.lease, lease)
             return shutdown.delivered_signal() or shutdown.active_signal()
 
         status = t.status
@@ -344,6 +452,7 @@ class SweepService:
             # server (and every other tenant with it): terminal-fail
             # just this tenant and keep scheduling
             t.write_status(dict(status, state=tstates.FAILED, note=str(e)))
+            leases.release(t.lease, lease)
             self._wrote_status(t)
             self._retire_usage(status)
             self.metrics.log("tenant_reject", job=t.job_id, error=str(e))
@@ -366,29 +475,66 @@ class SweepService:
             t.write_status(
                 dict(status, state=tstates.FAILED, note=f"slice setup failed: {e}")
             )
+            leases.release(t.lease, lease)
             self._wrote_status(t)
             self._retire_usage(status)
             self.metrics.log("tenant_reject", job=t.job_id, error=str(e))
             return None
+        if takeover_from is not None:
+            # the takeover IS the existing resume machinery — all that
+            # is new is the bookkeeping: the tenant's durable state is
+            # whatever the dead server's last boundary flushed, and the
+            # --resume in _slice_argv picks it up via verified-snapshot
+            # + journal-prefix exactly like a restart would
+            self._takeovers += 1
+            status = dict(
+                status,
+                takeovers=int(status.get("takeovers") or 0) + 1,
+                note=f"lease takeover from {takeover_from}",
+            )
+            self.metrics.count_takeovers()
+            self.metrics.log(
+                "tenant_takeover",
+                job=t.job_id,
+                from_server=takeover_from,
+                to_server=self.server_id,
+            )
         # slice_started_ts: the live-phase surface's elapsed anchor
-        # (spool.live_phase reads it back while the slice runs)
+        # (spool.live_phase reads it back while the slice runs);
+        # server: which fleet member holds the device for this slice
         t.write_status(
-            dict(status, state=tstates.RUNNING, slice_started_ts=round(time.time(), 4))
+            dict(
+                status,
+                state=tstates.RUNNING,
+                server=self.server_id,
+                slice_started_ts=round(time.time(), 4),
+            )
         )
         self._wrote_status(t)
         self.metrics.log(
             "slice_start",
             job=t.job_id,
             tenant=status.get("tenant", "default"),
+            server=self.server_id,
             slice=int(status.get("slices") or 0) + 1,
             program_cache_hit=cache_hit,
         )
         boundaries = 0
         t0 = time.perf_counter()
+        # the lease keeper: rides every heartbeat beat (driver batch,
+        # fused launch, wave sub-segment, staging transfer), refreshing
+        # the deadline at ttl/3 cadence; on fencing (we were presumed
+        # dead and taken over) it requests the SAME drain a slice
+        # expiry does, so the zombie slice parks at its next boundary
+        # instead of running on against a tenant it no longer owns
+        refresher = leases.Refresher(
+            t.lease, lease, self.lease_ttl, on_fenced=shutdown.request
+        )
 
         def hook(stage: str) -> None:
             nonlocal boundaries
             boundaries += 1
+            refresher()  # boundary-granular refresh floor (beat-less sweeps)
             if self.on_boundary is not None:
                 self.on_boundary(t, stage, boundaries)
             # delivered_signal: a real signal that landed in the sliver
@@ -397,7 +543,8 @@ class SweepService:
             # can't see — treat it like drain so the park still happens
             # at the FIRST boundary, not after a full quantum
             if (
-                t.cancel_requested()
+                refresher.fenced
+                or t.cancel_requested()
                 or self.spool.drain_requested()
                 or shutdown.delivered_signal()
             ):
@@ -415,7 +562,17 @@ class SweepService:
         # here on IS this slice's signal, and erasing it would burn a
         # full quantum before the server notices (the hook above and the
         # post-slice read both depend on it surviving)
+        def on_beat(rec) -> None:
+            # two refreshes ride every unit of tenant progress: the
+            # job's lease (the Refresher) and OUR fleet registration —
+            # the serve loop is blocked inside this very slice, and a
+            # registration left unrefreshed for a long slice would let
+            # a remote peer judge a live server dead
+            refresher(rec)
+            self._refresh_registration()
+
         shutdown.set_slice_hook(hook)
+        heartbeat.set_beat_listener(on_beat)
         # tenant tag for the slice's span records: cli.main's trace
         # wiring reads it, so a merged state-dir trace attributes phases
         # per tenant. Env (not a flag) because the spool's job argv must
@@ -462,6 +619,7 @@ class SweepService:
                         logf.write(traceback.format_exc())
                         rc = 1
         finally:
+            heartbeat.clear_beat_listener()
             shutdown.clear_slice_hook()
             if self.trace:
                 if prev_tag is None:
@@ -470,6 +628,13 @@ class SweepService:
                     os.environ["MPI_OPT_TPU_TRACE_TAG"] = prev_tag
         wall = time.perf_counter() - t0
         delivered = shutdown.delivered_signal()
+        # settle the refresher BEFORE judging the fence: an in-flight
+        # refresh (a straggler beat from a staging thread that outlived
+        # the listener clear) holds the lease file mid-rename, and
+        # judging held()/release() through that absence window would
+        # falsely fence a healthy slice — and then strand the refreshed
+        # lease unreleased until the TTL
+        final_lease = refresher.stop()
 
         cancel = t.cancel_requested()
         state = tstates.after_slice(rc, cancel)
@@ -477,6 +642,21 @@ class SweepService:
             # the sweep completed or drained at a boundary — both are
             # past compile, so the key's programs really exist now
             self.programs.commit(key)
+        # the fence: if our lease stopped carrying our token, this job
+        # was taken over while we were presumed dead — the new owner's
+        # status/ledger records are authoritative and EVERY write we
+        # intended for this tenant is abandoned (no status, no usage,
+        # no release: the lease is not ours to give up). The program
+        # commit above stays — it records compiles in THIS process.
+        if refresher.fenced or not leases.held(t.lease, final_lease):
+            self.metrics.log(
+                "slice_fenced",
+                job=t.job_id,
+                rc=rc,
+                boundaries=boundaries,
+                wall_s=round(wall, 3),
+            )
+            return delivered
         status = t.status  # re-read: cancel client may have raced a write
         status["state"] = state
         status["slices"] = int(status.get("slices") or 0) + 1
@@ -543,6 +723,10 @@ class SweepService:
             # every terminal transition passes through, including the
             # queue-cancel path that never reaches this slice-end code)
         t.write_status(status)
+        # the lease outlived every write it fenced; give it up so any
+        # fleet peer can pick the tenant for its next slice (fair share
+        # stays per-server, the lease only arbitrates "who, right now")
+        leases.release(t.lease, final_lease)
         self._wrote_status(t)
         name = status.get("tenant", "default")
         self._usage[name] = self._usage.get(name, 0) + 1
@@ -565,6 +749,7 @@ class SweepService:
             "slice_end",
             job=t.job_id,
             rc=rc,
+            server=self.server_id,
             state=state,
             boundaries=boundaries,
             wall_s=round(wall, 3),
@@ -597,13 +782,20 @@ class SweepService:
             import absl.logging  # noqa: F401
         except ImportError:
             pass
-        if not self.spool.claim_server(slice_boundaries=self.slice_boundaries):
-            from mpi_opt_tpu.service.spool import ServerClaimError
+        if not self.spool.register_server(
+            self.server_id,
+            slice_boundaries=self.slice_boundaries,
+            lease_ttl=self.lease_ttl,
+            takeovers=0,
+        ):
+            from mpi_opt_tpu.service.spool import ServerClaimError, _read_json
 
-            info = self.spool.read_server() or {}
+            info = _read_json(self.spool.server_file(self.server_id)) or {}
             raise ServerClaimError(
-                f"a server (pid {info.get('pid')}) already owns "
-                f"{self.spool.state_dir}; one device, one server"
+                f"a live server (pid {info.get('pid')}) already owns "
+                f"server-id {self.server_id!r} on {self.spool.state_dir}; "
+                "one identity, one process — federate with a distinct "
+                "--server-id"
             )
         self.spool.clear_drain()
         # open THIS server's signal-observation window: a signal a
@@ -617,19 +809,33 @@ class SweepService:
             from mpi_opt_tpu.obs import trace
 
             trace_prior = trace.configure(self.metrics)
-        self._recover_stale_running()
         self.metrics.log(
             "serve_start",
             state_dir=self.spool.state_dir,
+            server_id=self.server_id,
+            lease_ttl=self.lease_ttl,
             slice_boundaries=self.slice_boundaries,
             max_active_per_tenant=self.max_active_per_tenant,
         )
         reason = "drain"
+        rc = 0
         try:
             with shutdown.ShutdownGuard() as guard:
                 while True:
                     self._status_memo.clear()
                     self._tenants_memo = None
+                    if not self._heartbeat_server():
+                        # zombie fencing, server edition: another
+                        # process registered OUR id while we were
+                        # presumed dead. Its leases fence our tenant
+                        # writes; stepping down (not fighting) is the
+                        # only move that cannot split-brain the spool.
+                        reason = "usurped"
+                        rc = EX_UNAVAILABLE
+                        self.metrics.log(
+                            "server_usurped", server_id=self.server_id
+                        )
+                        break
                     self._admit_pending()
                     self._apply_queued_cancels()
                     if guard.requested or shutdown.delivered_signal():
@@ -637,14 +843,15 @@ class SweepService:
                         break
                     if self.spool.drain_requested():
                         break
-                    t = self._pick_next()
-                    if t is None:
+                    pick = self._pick_next()
+                    if pick is None:
                         if self.drain_on_empty and self._all_quiet():
                             reason = "empty"
                             break
                         time.sleep(self.poll_seconds)
                         continue
-                    delivered = self._run_slice(t)
+                    t, lease, takeover_from = pick
+                    delivered = self._run_slice(t, lease, takeover_from)
                     if delivered:
                         # the platform told the PROCESS to die; the
                         # active tenant already drained + parked through
@@ -656,7 +863,53 @@ class SweepService:
                 from mpi_opt_tpu.obs import trace
 
                 trace.deconfigure(trace_prior)
-            self.spool.clear_server()
+            # deregister ONLY if the file still records us: a stepped-
+            # down zombie unlinking the usurper's live registration
+            # would re-orphan the spool it just conceded
+            self.spool.clear_server_if_mine(self.server_id)
             self.metrics.summary(final=True, reason=reason)
             self.metrics.close()
-        return 0
+        return rc
+
+    def _refresh_registration(self) -> None:
+        """Refresh our fleet registration (throttled, monotonic): the
+        ``ts`` stamp is what remote-host peers and the status client
+        judge liveness by, and the takeover counter rides along.
+        Called from the serve loop between slices and from the beat
+        listener DURING one, so the longest unrefreshed gap is a beat
+        gap, not a slice. Usurpation latches ``_usurped``; transient
+        I/O failure rewinds the throttle so the next call retries —
+        neither ever raises into a beating thread."""
+        # non-blocking: beats arrive from more than one thread (main
+        # loop, staging transfer) — the loser skips, it must not stall
+        # the sweep behind the winner's registration write
+        if not self._reg_lock.acquire(blocking=False):
+            return
+        try:
+            now = time.monotonic()
+            if self._usurped or now < self._server_refresh_next:
+                return
+            self._server_refresh_next = now + self._server_refresh_every
+            try:
+                mine = self.spool.refresh_server(
+                    self.server_id,
+                    takeovers=self._takeovers,
+                    slices=self.metrics.slices,
+                )
+            except OSError:
+                self._server_refresh_next = 0.0  # sick fs: retry next call
+                return
+            if mine is None:
+                # unreadable != usurped: one torn read must not make a
+                # healthy server abandon its fleet slot — retry soon
+                self._server_refresh_next = 0.0
+            elif mine is False:
+                self._usurped = True
+        finally:
+            self._reg_lock.release()
+
+    def _heartbeat_server(self) -> bool:
+        """The serve loop's registration check: refresh, then report
+        whether we still own our identity (False = step down)."""
+        self._refresh_registration()
+        return not self._usurped

@@ -10,13 +10,14 @@ where it left off with no separate recovery file.
 Layout::
 
     state-dir/
-      server.json           # the live server's pid + heartbeat (liveness)
+      servers/<id>.json     # one registration per live server (fleet)
       server-metrics.jsonl  # the server's own JSONL metrics stream
       control/drain         # flag: finish the active slice, park, exit
       queue/<job>.json      # submitted jobs not yet admitted
       tenants/<job>/
         job.json            # the submitted spec (argv, tenant, ts)
         status.json         # tenant state machine record (tenants.py)
+        lease.json          # per-job claim (service/leases.py ONLY)
         cancel              # flag: cancel this job at its next boundary
         ledger.jsonl        # per-tenant durable trial journal
         ckpt/               # per-tenant snapshot root
@@ -24,14 +25,28 @@ Layout::
 
 Job ids are zero-padded submit-nanosecond stamps, so lexicographic
 order IS submission order (the FIFO tiebreak needs no extra index).
+
+Fleet federation (ISSUE 12): N servers share one spool. Each registers
+under ``servers/<server-id>.json`` (a server-id collision is the ONE
+refusal left — two processes claiming the same identity is operator
+error, and the default id keeps PR 7's one-server-per-spool behavior);
+per-JOB admission is arbitrated by ``tenants/<job>/lease.json``, owned
+end to end by :mod:`mpi_opt_tpu.service.leases`.
+
+Spool metadata I/O rides :func:`retry_io` — bounded, jitter-backed
+retries on transient ``OSError`` — so a slow or contended shared
+filesystem (the multi-server deployment's substrate) degrades to
+latency, not crashes.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import random
+import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 #: sweep flags the server owns per tenant; a submitted job naming one
 #: would fight the server over the tenant's durable-state layout (or,
@@ -59,25 +74,97 @@ class SpoolError(ValueError):
 
 
 class ServerClaimError(RuntimeError):
-    """Another live server already owns this spool (one device, one
-    server). The ONE serve failure that is usage-shaped: the operator
-    pointed a second server at a claimed state-dir."""
+    """Another live server already owns this server-id on this spool.
+    The ONE serve failure that is usage-shaped: the operator pointed a
+    second server at an identity that is still alive — federating needs
+    a distinct ``--server-id`` per server, not a shared one."""
+
+
+#: answers, not faults: the retry layer must never spin on a path that
+#: is genuinely absent/present/misshaped — those outcomes are what the
+#: caller is asking about (O_EXCL losing a race, a missing status file)
+_NON_TRANSIENT_OS = (
+    FileNotFoundError,
+    FileExistsError,
+    IsADirectoryError,
+    NotADirectoryError,
+    PermissionError,
+)
+
+#: chaos seam (workloads/chaos.py inject_spool_faults): when installed,
+#: called as ``fn(op, path)`` before every spool metadata primitive
+#: ("replace" before os.replace, "read" before a JSON read, "list"
+#: before a directory listing) and may raise OSError or sleep — INSIDE
+#: the retry wrapper, so each attempt re-consults the schedule
+_FAULTS: Optional[Callable[[str, str], None]] = None
+
+
+def set_fault_injector(fn: Optional[Callable[[str, str], None]]) -> None:
+    global _FAULTS
+    _FAULTS = fn
+
+
+def _fault(op: str, path: str) -> None:
+    if _FAULTS is not None:
+        _FAULTS(op, path)
+
+
+def retry_io(fn, attempts: int = 4, base_s: float = 0.02, sleep=time.sleep):
+    """Run ``fn`` with bounded retry-with-jittered-backoff on transient
+    ``OSError`` (EIO under load, NFS ESTALE, EAGAIN — the weather of a
+    contended shared filesystem). Non-transient shapes
+    (``FileNotFoundError``, ``FileExistsError``, permission refusals)
+    raise immediately: they are answers the caller's protocol depends
+    on, and "retrying" an O_EXCL loss would turn a lost race into a
+    4x-slower lost race. The last attempt's error propagates raw."""
+    for i in range(attempts):
+        try:
+            return fn()
+        except _NON_TRANSIENT_OS:
+            raise
+        except OSError:
+            if i == attempts - 1:
+                raise
+            sleep(base_s * (2**i) * (0.5 + random.random()))
 
 
 def _write_json_atomic(path: str, obj: dict) -> None:
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(obj, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    def _go():
+        # pid AND thread in the tmp name: writers on different threads
+        # (the serve loop vs the heartbeat-riding refresh) must never
+        # truncate each other's half-written tmp out from under its
+        # rename (the heartbeat module learned this the hard way)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(obj, f)
+                f.flush()
+                os.fsync(f.fileno())
+            _fault("replace", path)
+            os.replace(tmp, path)
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    retry_io(_go)
 
 
 def _read_json(path: str) -> Optional[dict]:
+    def _go():
+        _fault("read", path)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
     try:
-        with open(path) as f:
-            return json.load(f)
-    except (OSError, json.JSONDecodeError):
+        return retry_io(_go)
+    except OSError:
+        # persistently unreadable == unreadable: every caller treats
+        # None as "no usable record here", which is the degraded truth
         return None
 
 
@@ -94,6 +181,122 @@ def _pid_start(pid: int) -> Optional[str]:
         return stat.rsplit(")", 1)[1].split()[19]
     except (OSError, IndexError):
         return None
+
+
+# -- the exclusive-claim primitives ----------------------------------------
+#
+# ONE home for the subtle parts of every claim-file transaction (server
+# registrations, and — via service/leases.py — per-job lease acquire,
+# refresh, and release all ride these; diverging copies of this dance
+# is how fencing bugs are born): an O_EXCL fsync'd create that exactly
+# one process can win, and a rename-into-tomb that exactly one process
+# can perform. Composed, they give check-free exclusivity — never
+# read-modify-write.
+
+
+def excl_write_json(path: str, record: dict) -> bool:
+    """Atomically create ``path`` holding ``record`` iff absent
+    (``O_EXCL``, fsync'd). False = the path exists (the caller lost the
+    race and must concede); transient I/O rides :func:`retry_io` and a
+    persistently sick filesystem raises raw."""
+    try:
+        fd = retry_io(lambda: os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+    except FileExistsError:
+        return False
+    with os.fdopen(fd, "w") as f:
+        json.dump(record, f)
+        f.flush()
+        os.fsync(f.fileno())
+    return True
+
+
+def tomb_take(path: str) -> Optional[tuple]:
+    """Exclusively move ``path`` into a caller-owned tomb (rename wins
+    for exactly ONE process) and read it: ``(tomb_path, record_or_None)``,
+    or None when the path did not exist. The caller must end with
+    :func:`tomb_discard` (and restore via :func:`excl_write_json` first
+    when the record turns out not to be its to take)."""
+    tomb = f"{path}.tomb.{os.getpid()}.{threading.get_ident()}"
+    try:
+        retry_io(lambda: os.rename(path, tomb))
+    except FileNotFoundError:
+        return None
+    return tomb, _read_json(tomb)
+
+
+def tomb_discard(tomb: str) -> None:
+    """Best-effort tomb cleanup — orphaned tomb debris is inert (it is
+    never a claim), so failure here is never worth raising over."""
+    try:
+        os.unlink(tomb)
+    except OSError:
+        pass
+
+
+def claim_file(path: str, payload: dict, stealable, attempts: int = 8) -> Optional[dict]:
+    """The exclusive-claim protocol: atomically create ``path`` holding
+    ``payload`` iff it is absent or ``stealable(current)``. A stealable
+    claim is replaced via rename-tomb, and the tomb is inspected AFTER
+    the steal so a peer's fresh re-claim that raced our staleness read
+    is restored and conceded, never destroyed. Returns ``payload`` on
+    win, None on concede."""
+    for _ in range(attempts):  # bounded: every retry means the file changed
+        if excl_write_json(path, payload):
+            return payload
+        cur = _read_json(path)
+        if cur is not None and not stealable(cur):
+            return None  # live holder; we lose
+        taken = tomb_take(path)
+        if taken is None:
+            continue  # another claimant removed it; retry the create
+        tomb, stolen = taken
+        tomb_discard(tomb)
+        if stolen is not None and not stealable(stolen):
+            # we stole a LIVE claim (the holder refreshed between our
+            # read and our rename) — put it back and concede
+            try:
+                excl_write_json(path, stolen)
+            except OSError:
+                pass  # can't restore: still concede; TTL re-heals
+            return None
+        continue  # the claim really was stealable; retry the create
+    return None
+
+
+_HOST_ID: Optional[str] = None
+
+
+def _local_host() -> str:
+    """This machine's identity, for cross-host liveness judgement (a
+    pid recorded by another host is not a pid here). The nodename alone
+    is NOT unique enough to gate a "provably dead, take over now"
+    verdict — cloned VMs and templated containers ship identical
+    hostnames, and a collision would let a peer probe a REMOTE holder's
+    pid locally, find it absent, and steal a live lease with no TTL
+    wait. The kernel boot id (random per boot) disambiguates; it also
+    makes a rebooted host read as "different host", which is correct —
+    its old pids mean nothing after the reboot, so freshness/TTL (not
+    pid probing) is the right judgement there. Hosts without the proc
+    file (non-Linux) fall back to the bare nodename, keeping the old
+    behavior and its documented residual risk."""
+    global _HOST_ID
+    if _HOST_ID is None:
+        try:
+            node = os.uname().nodename
+        except (AttributeError, OSError):  # pragma: no cover - non-posix
+            node = "unknown-host"
+        try:
+            with open("/proc/sys/kernel/random/boot_id") as f:
+                _HOST_ID = f"{node}/{f.read().strip()[:13]}"
+            # boot_id is KERNEL-wide: two containers sharing a kernel
+            # (and, via a templated config, a nodename) would still
+            # collide — and a pid probed across PID namespaces is just
+            # as meaningless as one probed across machines. The pid-ns
+            # inode completes the "same pid world" judgement.
+            _HOST_ID += f"/{os.stat('/proc/self/ns/pid').st_ino}"
+        except OSError:  # pragma: no cover - non-linux
+            _HOST_ID = node
+    return _HOST_ID
 
 
 def check_argv(argv: list) -> None:
@@ -124,6 +327,10 @@ class TenantDir:
         self.dir = os.path.join(root, job_id)
         self.job_path = os.path.join(self.dir, "job.json")
         self.status_path = os.path.join(self.dir, "status.json")
+        # per-job claim file (fleet federation): written ONLY by
+        # service/leases.py — the path lives here so readers (status,
+        # report) and the lease helpers agree on one location
+        self.lease = os.path.join(self.dir, "lease.json")
         self.cancel_path = os.path.join(self.dir, "cancel")
         self.ledger = os.path.join(self.dir, "ledger.jsonl")
         self.ckpt = os.path.join(self.dir, "ckpt")
@@ -149,6 +356,18 @@ class TenantDir:
     def write_status(self, status: dict) -> None:
         status = dict(status, updated_ts=round(time.time(), 4))
         _write_json_atomic(self.status_path, status)
+
+    def create_status(self, status: dict) -> bool:
+        """Write the INITIAL status record only if none exists yet
+        (``excl_write_json``: with N servers racing the same admission,
+        exactly one initial write wins and a peer's later duplicate
+        admission can never reset a tenant that is already running —
+        and the shared primitive carries the retry budget, so transient
+        admission-time I/O degrades to latency like every other spool
+        metadata op). Returns whether THIS call created it."""
+        return excl_write_json(
+            self.status_path, dict(status, updated_ts=round(time.time(), 4))
+        )
 
     def cancel_requested(self) -> bool:
         return os.path.exists(self.cancel_path)
@@ -198,11 +417,16 @@ class Spool:
         self.queue_dir = os.path.join(state_dir, "queue")
         self.tenants_dir = os.path.join(state_dir, "tenants")
         self.control_dir = os.path.join(state_dir, "control")
-        self.server_path = os.path.join(state_dir, "server.json")
+        self.servers_dir = os.path.join(state_dir, "servers")
         self.metrics_path = os.path.join(state_dir, "server-metrics.jsonl")
         self._drain_path = os.path.join(self.control_dir, "drain")
         if create:
-            for d in (self.queue_dir, self.tenants_dir, self.control_dir):
+            for d in (
+                self.queue_dir,
+                self.tenants_dir,
+                self.control_dir,
+                self.servers_dir,
+            ):
                 os.makedirs(d, exist_ok=True)
         elif not os.path.isdir(self.queue_dir):
             raise SpoolError(
@@ -291,11 +515,16 @@ class Spool:
 
     def pending_jobs(self) -> list:
         """Queue files in submission (= lexicographic) order."""
-        return sorted(
-            os.path.join(self.queue_dir, f)
-            for f in os.listdir(self.queue_dir)
-            if f.endswith(".json")
-        )
+
+        def _go():
+            _fault("list", self.queue_dir)
+            return sorted(
+                os.path.join(self.queue_dir, f)
+                for f in os.listdir(self.queue_dir)
+                if f.endswith(".json")
+            )
+
+        return retry_io(_go)
 
     def _materialize(self, queue_path: str) -> TenantDir:
         """Move a queue file into a tenant dir (the admission step's
@@ -318,7 +547,11 @@ class Spool:
         t = TenantDir(self.tenants_dir, spec["id"])
         os.makedirs(t.dir, exist_ok=True)
         _write_json_atomic(t.job_path, spec)
-        t.write_status(
+        # create-if-absent: with N servers sharing the spool, a slow
+        # peer re-running this admission (it read the queue file before
+        # we unlinked it) must not RESET a tenant that already ran —
+        # only the first initial-status write lands
+        t.create_status(
             {
                 "id": spec["id"],
                 "tenant": spec.get("tenant", "default"),
@@ -326,6 +559,7 @@ class Spool:
                 "slices": 0,
                 "preemptions": 0,
                 "boundaries": 0,
+                "takeovers": 0,
                 "rc_history": [],
                 "program_cache": {"hits": 0, "misses": 0},
                 "submitted_ts": spec.get("submitted_ts"),
@@ -346,50 +580,125 @@ class Spool:
 
     def tenants(self) -> list:
         """All admitted tenants, submission-ordered."""
-        return [
-            TenantDir(self.tenants_dir, d)
-            for d in sorted(os.listdir(self.tenants_dir))
-            if os.path.isdir(os.path.join(self.tenants_dir, d))
-        ]
 
-    # -- server liveness ---------------------------------------------
+        def _go():
+            _fault("list", self.tenants_dir)
+            return [
+                TenantDir(self.tenants_dir, d)
+                for d in sorted(os.listdir(self.tenants_dir))
+                if os.path.isdir(os.path.join(self.tenants_dir, d))
+            ]
+
+        return retry_io(_go)
+
+    # -- server registry (fleet liveness) ----------------------------
+
+    #: the id a server registers under when the operator names none —
+    #: a FIXED default on purpose: two default-id servers collide, so
+    #: PR 7's one-server-per-spool behavior is preserved until the
+    #: operator opts into federation with distinct --server-id values
+    DEFAULT_SERVER_ID = "server"
+
+    #: a registration whose refresh timestamp is older than this many
+    #: seconds is treated as dead when its pid cannot be judged (the
+    #: holder runs on another host); local pids are judged directly.
+    #: GENEROUS on purpose: the refresh rides the serve loop AND the
+    #: active tenant's heartbeat beats, whose longest gap is the cold
+    #: XLA-compile window (140-210 s measured) — judging a remote
+    #: server dead mid-compile would let a same-id peer usurp a live
+    #: process. The cost of the slack is only that a genuinely dead
+    #: REMOTE server's id stays refused this long (same-host death is
+    #: pid-judged instantly, and per-job leases — not registrations —
+    #: gate the actual work).
+    SERVER_STALE_S = 600.0
+
+    def server_file(self, server_id: str) -> str:
+        return os.path.join(self.servers_dir, f"{server_id}.json")
+
+    @property
+    def server_path(self) -> str:
+        """The default-id registration path (the single-server shape
+        tests and drills forge against)."""
+        return self.server_file(self.DEFAULT_SERVER_ID)
+
+    def read_servers(self) -> list:
+        """Every registration on the spool (live or stale), sorted by
+        server id. Missing servers/ (a pre-fleet spool a read-only
+        client points at) reads as an empty fleet, not an error."""
+
+        def _go():
+            if not os.path.isdir(self.servers_dir):
+                return []
+            _fault("list", self.servers_dir)
+            return sorted(
+                f for f in os.listdir(self.servers_dir) if f.endswith(".json")
+            )
+
+        out = []
+        for fname in retry_io(_go):
+            rec = _read_json(os.path.join(self.servers_dir, fname))
+            if rec is not None:
+                rec.setdefault("server_id", fname[: -len(".json")])
+                out.append(rec)
+        return out
 
     def read_server(self) -> Optional[dict]:
-        return _read_json(self.server_path)
+        """The most recently refreshed registration, or None — the
+        aggregate single-server view ``drain --wait`` and the status
+        header key on."""
+        servers = self.read_servers()
+        if not servers:
+            return None
+        return max(servers, key=lambda r: float(r.get("ts") or 0.0))
 
-    def server_alive(self) -> bool:
-        return self._pid_alive(self.read_server())
+    def server_alive(self, info: Optional[dict] = None) -> bool:
+        """Is any server (or the given registration) live?"""
+        if info is not None:
+            return self._server_live(info)
+        return any(self._server_live(r) for r in self.read_servers())
 
-    def _claim_fields(self, **fields) -> dict:
+    def _claim_fields(self, server_id: str, **fields) -> dict:
         return {
+            "server_id": server_id,
             "pid": os.getpid(),
             "pid_start": _pid_start(os.getpid()),
+            "host": _local_host(),
             "ts": round(time.time(), 4),
             **fields,
         }
 
-    def write_server(self, **fields) -> None:
-        _write_json_atomic(self.server_path, self._claim_fields(**fields))
+    def write_server(self, server_id: str = DEFAULT_SERVER_ID, **fields) -> None:
+        """Forge/refresh a registration AS THIS PROCESS (tests, and the
+        serve loop's refresh path goes through refresh_server below)."""
+        _write_json_atomic(self.server_file(server_id), self._claim_fields(server_id, **fields))
 
-    def _pid_alive(self, info: Optional[dict]) -> bool:
+    def _server_live(self, info: Optional[dict]) -> bool:
         if not info or "pid" not in info:
             return False
+        host = info.get("host")
+        if host is not None and host != _local_host():
+            # a pid means nothing across machines: judge a remote
+            # server by registration freshness only
+            try:
+                return (time.time() - float(info["ts"])) <= self.SERVER_STALE_S
+            except (KeyError, TypeError, ValueError):
+                return False
         try:
             pid = int(info["pid"])
             os.kill(pid, 0)
         except PermissionError:
             # EPERM is a LIVE process owned by someone else — on a
-            # shared state-dir the one-server-per-spool refusal must
-            # still see it (and /proc/<pid>/stat below stays readable)
+            # shared state-dir the same-id refusal must still see it
+            # (and /proc/<pid>/stat below stays readable)
             pass
         except (OSError, ValueError):
             return False
         # the pid exists — but is it the SAME process? A SIGKILLed
-        # server never clears its claim, and the kernel eventually
-        # recycles its pid for an unrelated process, which would hold
-        # the spool hostage until an operator deleted server.json by
-        # hand. The recorded start time settles it; claims without one
-        # (older files, non-Linux hosts) keep the bare-pid behavior.
+        # server never clears its registration, and the kernel
+        # eventually recycles its pid for an unrelated process, which
+        # would hold the server-id hostage until an operator deleted
+        # the file by hand. The recorded start time settles it; records
+        # without one (non-Linux hosts) keep the bare-pid behavior.
         recorded = info.get("pid_start")
         if recorded is not None:
             current = _pid_start(pid)
@@ -397,57 +706,81 @@ class Spool:
                 return False
         return True
 
-    def claim_server(self, **fields) -> bool:
-        """Atomically claim the spool for THIS process (O_EXCL create of
-        server.json — a check-then-write would let two servers racing
-        through the same window both believe they own the device).
+    # back-compat alias (pre-fleet name; scheduler/tests used it)
+    def _pid_alive(self, info: Optional[dict]) -> bool:
+        return self._server_live(info)
 
-        A claim held by a dead pid (SIGKILLed server) is broken via
-        rename-takeover: rename wins for exactly ONE claimant, and the
-        renamed file is inspected AFTER the steal — if it turns out to
-        be a peer's fresh LIVE claim (the peer broke the stale one and
-        re-claimed between our read and our rename), it is restored and
-        we lose. Returns False when a live server holds the spool."""
-        for _ in range(8):  # bounded: every retry means the file changed
-            try:
-                fd = os.open(
-                    self.server_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY
-                )
-            except FileExistsError:
-                if self.server_alive():
-                    return False
-                tomb = f"{self.server_path}.stale.{os.getpid()}"
-                try:
-                    os.rename(self.server_path, tomb)
-                except FileNotFoundError:
-                    continue  # another claimant removed it; retry O_EXCL
-                stolen = _read_json(tomb)
-                try:
-                    os.unlink(tomb)
-                except FileNotFoundError:
-                    pass
-                if self._pid_alive(stolen):
-                    # we stole a live claim — put it back and concede
-                    try:
-                        restore = os.open(
-                            self.server_path,
-                            os.O_CREAT | os.O_EXCL | os.O_WRONLY,
-                        )
-                    except FileExistsError:
-                        return False
-                    with os.fdopen(restore, "w") as f:
-                        json.dump(stolen, f)
-                    return False
-                continue  # the claim really was dead; retry O_EXCL
-            with os.fdopen(fd, "w") as f:
-                json.dump(self._claim_fields(**fields), f)
-                f.flush()
-                os.fsync(f.fileno())
-            return True
-        return False
+    def register_server(self, server_id: str = DEFAULT_SERVER_ID, **fields) -> bool:
+        """Atomically register THIS process under ``server_id`` (O_EXCL
+        create — a check-then-write would let two servers racing through
+        the same window both believe they own the identity).
 
-    def clear_server(self) -> None:
+        A registration held by a dead pid (SIGKILLed server) is broken
+        via rename-takeover: rename wins for exactly ONE claimant, and
+        the renamed file is inspected AFTER the steal — if it turns out
+        to be a peer's fresh LIVE registration (the peer broke the stale
+        one and re-registered between our read and our rename), it is
+        restored and we lose. Returns False when a live server holds
+        the id."""
+        won = claim_file(
+            self.server_file(server_id),
+            self._claim_fields(server_id, **fields),
+            stealable=lambda cur: not self._server_live(cur),
+        )
+        return won is not None
+
+    def _registration_is_mine(self, cur: Optional[dict]) -> bool:
+        return (
+            cur is not None
+            and cur.get("pid") == os.getpid()
+            and cur.get("pid_start") == _pid_start(os.getpid())
+        )
+
+    def refresh_server(self, server_id: str, **fields) -> Optional[bool]:
+        """Re-stamp our registration's heartbeat ``ts`` (and any counter
+        fields). Identity-checked against THIS process before AND after
+        the write. Tri-state: True = refreshed and still ours; False =
+        the file READABLY records someone else (or is gone) — another
+        process claimed the id while we were presumed dead, the caller
+        (the serve loop) must STEP DOWN rather than fight; None = the
+        file is present but unreadable (torn read, persistent EIO the
+        retry budget couldn't clear) — CANNOT TELL, and a caller that
+        treated it as usurped would have a healthy server abandon its
+        fleet slot over one NFS blip. Retry later instead.
+
+        Honesty note: check-write-verify is not fully exclusive (a
+        usurper's registration landing inside the write window is
+        clobbered, detected only by whoever verifies last). Making it
+        so would rename the file away mid-refresh, and a concurrent
+        ``server_alive`` poll would see a live server flicker absent.
+        The race is survivable by construction: usurping requires the
+        registration to be STALE (``SERVER_STALE_S`` with refresh
+        riding both the serve loop and the tenant's heartbeat beats),
+        so a clobber needs a >10-minute-hung process — and per-job
+        leases, not registrations, gate the actual work either way."""
+        path = self.server_file(server_id)
+        cur = _read_json(path)
+        if cur is None:
+            return None if os.path.exists(path) else False
+        if not self._registration_is_mine(cur):
+            return False
+        _write_json_atomic(path, dict(cur, ts=round(time.time(), 4), **fields))
+        after = _read_json(path)
+        if after is None:
+            return None if os.path.exists(path) else False
+        return True if self._registration_is_mine(after) else False
+
+    def clear_server(self, server_id: str = DEFAULT_SERVER_ID) -> None:
         try:
-            os.unlink(self.server_path)
+            os.unlink(self.server_file(server_id))
         except FileNotFoundError:
             pass
+
+    def clear_server_if_mine(self, server_id: str) -> bool:
+        """Deregister on the way out — but ONLY if the file still
+        records this process. A stepped-down zombie must not unlink the
+        usurper's live registration as its parting act."""
+        if not self._registration_is_mine(_read_json(self.server_file(server_id))):
+            return False
+        self.clear_server(server_id)
+        return True

@@ -11,7 +11,8 @@ States::
     running ──rc 2───────────────> failed      (usage: deterministic)
     running ──rc other───────────> failed
     queued/parked ──cancel───────> cancelled
-    running + dead server────────> parked      (recovered on restart)
+    running + dead/expired lease─> (takeover)  (any live fleet server
+                                   claims the lease and resumes it)
 
 The rc classification is ``utils.exitcodes.classify`` — the SAME map
 the launch supervisor uses, so a sweep's exit means one thing

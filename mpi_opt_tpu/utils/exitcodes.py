@@ -24,6 +24,12 @@ The codes (sysexits.h where one exists):
   with ``--resume``, and don't bill the retry budget." The service's
   time-slice preemption exits through the same drain path, so 75 is
   also the code a parked tenant leaves behind.
+- ``EX_UNAVAILABLE`` (69): the fleet's zombie-fencing code — a server
+  discovered its own identity was usurped (another process registered
+  its ``--server-id`` while it was presumed dead) and STEPPED DOWN
+  rather than fight over the spool. The work is fine; this process's
+  claim to it is not. A supervisor may restart it under a fresh id;
+  retrying the same identity re-refuses while the usurper lives.
 """
 
 from __future__ import annotations
@@ -33,6 +39,9 @@ EX_FAILURE = 1
 EX_USAGE = 2
 # sysexits.h EX_DATAERR: "input data was incorrect in some way"
 EX_DATAERR = 65
+# sysexits.h EX_UNAVAILABLE: "service unavailable" — the fenced-zombie
+# step-down (fleet federation; see service/leases.py)
+EX_UNAVAILABLE = 69
 # sysexits.h EX_TEMPFAIL: "temporary failure, user is invited to retry"
 EX_TEMPFAIL = 75
 
@@ -40,15 +49,17 @@ _OUTCOMES = {
     EX_OK: "ok",
     EX_USAGE: "usage",
     EX_DATAERR: "data_error",
+    EX_UNAVAILABLE: "unavailable",
     EX_TEMPFAIL: "preempted",
 }
 
 
 def classify(rc: int) -> str:
     """Exit code -> outcome class: ``ok`` / ``usage`` / ``data_error``
-    / ``preempted`` / ``failure`` (the catch-all for every other
-    nonzero code, including 1). ``preempted`` is the only outcome that
-    means "resumable, for free"; ``usage`` and ``data_error`` are
-    terminal-without-retry; ``failure`` is terminal-or-retry at the
-    caller's budget."""
+    / ``unavailable`` / ``preempted`` / ``failure`` (the catch-all for
+    every other nonzero code, including 1). ``preempted`` is the only
+    outcome that means "resumable, for free"; ``usage`` and
+    ``data_error`` are terminal-without-retry; ``unavailable`` is the
+    fleet's step-down (the PROCESS lost its identity, the work did
+    not); ``failure`` is terminal-or-retry at the caller's budget."""
     return _OUTCOMES.get(int(rc), "failure")

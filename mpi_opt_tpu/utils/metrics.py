@@ -74,6 +74,10 @@ class MetricsLogger:
         self.tenants_done = 0
         self.program_cache_hits = 0
         self.program_cache_misses = 0
+        # fleet-federation counter (service/leases.py): orphaned jobs
+        # this server claimed from a dead/expired peer's lease and
+        # resumed — the observable form of "a dead host strands nothing"
+        self.takeovers = 0
 
     def log(self, event: str, **fields) -> dict:
         # `t` is relative (this process's clock, for intra-run deltas);
@@ -150,6 +154,10 @@ class MetricsLogger:
         self.program_cache_hits += int(hits)
         self.program_cache_misses += int(misses)
 
+    def count_takeovers(self, n: int = 1):
+        """Expired-lease tenant takeovers this server performed."""
+        self.takeovers += int(n)
+
     @property
     def wall(self) -> float:
         return time.perf_counter() - self.t_start
@@ -176,6 +184,7 @@ class MetricsLogger:
             tenants_done=self.tenants_done,
             program_cache_hits=self.program_cache_hits,
             program_cache_misses=self.program_cache_misses,
+            takeovers=self.takeovers,
             wall_s=round(self.wall, 3),
             trials_per_sec_per_chip=round(self.trials_per_sec_per_chip(), 4),
             **extra,

@@ -180,6 +180,118 @@ def inject_corrupt_save(checkpoint_dir: str, seed: int = 0, step=None) -> str:
     return path
 
 
+# -- spool-fault injectors (fleet federation, ISSUE 12) ---------------------
+#
+# The two injectors above strike durable state BETWEEN runs; these
+# strike the service spool's metadata primitives WHILE a scheduler (or
+# a whole fleet of them) is working: delayed/failed ``os.replace`` on
+# status/lease/queue writes and EIO on status reads — the weather of a
+# slow or contended shared filesystem, which is exactly the substrate a
+# multi-server spool runs on. Direct-call style like ``inject_torn_save``
+# (install, drive the drill, uninstall), deterministic by construction:
+# faults fire on exact op ordinals, optionally chosen by a seeded draw
+# over a window, never by wall clock or scheduling.
+
+
+class SpoolFaultInjector:
+    """The schedule ``inject_spool_faults`` installs into
+    ``service.spool``'s fault seam. Counts every op per kind
+    ("replace" / "read" / "list") and raises ``OSError(EIO)`` on the
+    scheduled ordinals (read faults only strike status.json reads —
+    the ISSUE's "EIO on status reads" shape — so job-spec parsing
+    stays out of scope); ``replace_delay_s`` sleeps before every
+    replace while installed (the slow-NFS shape). Thread-safe: the
+    scheduler's staging/heartbeat threads share the seam."""
+
+    def __init__(
+        self,
+        replace_fail: int = 0,
+        read_fail: int = 0,
+        replace_delay_s: float = 0.0,
+        seed: int = 0,
+        ops_window: int | None = None,
+    ):
+        import threading
+
+        self.replace_delay_s = float(replace_delay_s)
+        self._lock = threading.Lock()
+        self._counts = {"replace": 0, "read": 0, "list": 0}
+        self._fail = {
+            "replace": self._schedule("replace", replace_fail, seed, ops_window),
+            "read": self._schedule("read", read_fail, seed, ops_window),
+        }
+        self.faults_fired = {"replace": 0, "read": 0}
+
+    @staticmethod
+    def _schedule(kind: str, n: int, seed: int, window: int | None) -> frozenset:
+        """Which op ordinals (0-based) fault: the first ``n`` when no
+        window is given, else a seeded SHA-draw sample of ``n`` distinct
+        ordinals from ``range(window)`` — deterministic per (kind,
+        seed, n, window), independent of scheduling."""
+        if n <= 0:
+            return frozenset()
+        if window is None or window <= n:
+            return frozenset(range(n))
+        picked: set = set()
+        i = 0
+        while len(picked) < n:
+            h = hashlib.sha256(f"spool:{kind}:{seed}:{i}".encode()).digest()
+            picked.add(int.from_bytes(h[:8], "big") % window)
+            i += 1
+        return frozenset(picked)
+
+    def __call__(self, op: str, path: str) -> None:
+        import errno
+        import time as _time
+
+        if op == "replace" and self.replace_delay_s > 0:
+            _time.sleep(self.replace_delay_s)
+        if op == "read" and not path.endswith("status.json"):
+            return
+        with self._lock:
+            ordinal = self._counts.get(op, 0)
+            self._counts[op] = ordinal + 1
+            fire = ordinal in self._fail.get(op, ())
+            if fire:
+                self.faults_fired[op] += 1
+        if fire:
+            raise OSError(
+                errno.EIO, f"chaos: injected spool {op} fault (op {ordinal})", path
+            )
+
+
+def inject_spool_faults(
+    replace_fail: int = 0,
+    read_fail: int = 0,
+    replace_delay_s: float = 0.0,
+    seed: int = 0,
+    ops_window: int | None = None,
+):
+    """Install a seeded, deterministic fault schedule on the service
+    spool's metadata ops. Returns ``(injector, uninstall)`` — call
+    ``uninstall()`` when the drill is over (tests do it in a finally).
+    The spool's bounded retry-with-jittered-backoff (spool.retry_io)
+    absorbs schedules shorter than its attempt budget — the drill for
+    "a contended shared filesystem degrades to latency, not crashes" —
+    while a schedule longer than the budget surfaces the OSError, the
+    drill for the failure path."""
+    from mpi_opt_tpu.service import spool as spool_mod
+
+    injector = SpoolFaultInjector(
+        replace_fail=replace_fail,
+        read_fail=read_fail,
+        replace_delay_s=replace_delay_s,
+        seed=seed,
+        ops_window=ops_window,
+    )
+    spool_mod.set_fault_injector(injector)
+
+    def uninstall() -> None:
+        spool_mod.set_fault_injector(None)
+
+    return injector, uninstall
+
+
 @register
 class ChaosWorkload(Workload):
     name = "chaos"

@@ -13,6 +13,7 @@
 #        T1_SKIP_FSCK_DRILL=1 probes/tier1.sh # skip the fsck drill
 #        T1_SKIP_FUSED_LEDGER_DRILL=1 probes/tier1.sh # skip the ledger drill
 #        T1_SKIP_SERVICE_DRILL=1 probes/tier1.sh # skip the sweep-service drill
+#        T1_SKIP_FLEET_DRILL=1 probes/tier1.sh # skip the fleet-federation drill
 #        T1_SKIP_TRACE_DRILL=1 probes/tier1.sh # skip the span-trace drill
 #        T1_SKIP_PERFDIFF_DRILL=1 probes/tier1.sh # skip the trace-diff gate drill
 #        T1_SKIP_TIMELINE_DRILL=1 probes/tier1.sh # skip the timeline/bubble drill
@@ -167,6 +168,85 @@ PYEOF
         echo "SERVICE_DRILL=pass"
     else
         echo "SERVICE_DRILL=FAIL"
+        rc=$(( rc == 0 ? 1 : rc ))
+    fi
+fi
+
+# -- fleet-federation drill (multi-server spool, service/leases.py) --
+# Two servers, one spool: srv-a (driven in-process so the kill lands at
+# an exact boundary) SIGKILLs itself mid-slice of the first tenant;
+# survivor srv-b claims the dead holder's lease immediately (pid+/proc
+# start-time fast path — no TTL wait, even with 600 s left on the
+# lease), resumes via the ordinary --resume machinery, and finishes
+# BOTH tenants. Asserts: both done, the orphan counted >= 1 takeover
+# and finished on srv-b, its ledger is record-identical to an
+# uninterrupted solo run with every trial id unique (nothing executed
+# twice), and report --validate + per-tenant fsck audit clean.
+if [ -z "$T1_SKIP_FLEET_DRILL" ]; then
+    ft_rc=0
+    FS=$(mktemp -d /tmp/_t1_fleet.XXXXXX)
+    fmop() { env JAX_PLATFORMS=cpu python -m mpi_opt_tpu "$@"; }
+    fleet_submit() {  # $1=tenant $2=seed $3=trials -> job id on stdout
+        fmop submit --state-dir "$FS" --tenant "$1" -- \
+            --workload quadratic --algorithm random --trials "$3" \
+            --budget 3 --workers 1 --seed "$2" \
+            | python -c 'import json,sys; print(json.load(sys.stdin)["job"])'
+    }
+    FJ1=$(fleet_submit alice 0 24) || ft_rc=1
+    FJ2=$(fleet_submit bob 1 6) || ft_rc=1
+    # server srv-a: SIGKILL itself at boundary 3 of the first slice
+    timeout -k 10 180 env JAX_PLATFORMS=cpu python - "$FS" >/dev/null 2>&1 <<'PYEOF'
+import os, signal, sys
+from mpi_opt_tpu.service.scheduler import SweepService
+def boom(t, stage, n):
+    if n == 3:
+        os.kill(os.getpid(), signal.SIGKILL)
+svc = SweepService(sys.argv[1], server_id="srv-a", slice_boundaries=100,
+                   lease_ttl=600, poll_seconds=0.05, on_boundary=boom)
+sys.exit(svc.serve())
+PYEOF
+    [ $? -eq 137 ] || ft_rc=1             # the SIGKILL must have landed
+    timeout -k 10 240 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        serve --state-dir "$FS" --server-id srv-b --slice-boundaries 2 \
+        --lease-ttl 600 --drain-on-empty >/dev/null 2>&1 || ft_rc=1
+    fmop status --state-dir "$FS" --json >"$FS/_status.json" 2>/dev/null || ft_rc=1
+    env FJ1="$FJ1" FJ2="$FJ2" python - "$FS/_status.json" <<'PYEOF' || ft_rc=1
+import json, os, sys
+st = {j["job"]: j for j in json.load(open(sys.argv[1]))["jobs"]}
+a, b = st[os.environ["FJ1"]], st[os.environ["FJ2"]]
+assert a["state"] == "done" and b["state"] == "done", st
+assert (a.get("takeovers") or 0) >= 1, a   # the orphan changed hands
+assert a.get("server") == "srv-b", a       # ...and finished on the survivor
+PYEOF
+    # record-identity: the taken-over tenant's ledger == a solo run's
+    timeout -k 10 180 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        --workload quadratic --algorithm random --trials 24 --budget 3 \
+        --workers 1 --seed 0 --ledger "$FS/solo.jsonl" >/dev/null 2>&1 || ft_rc=1
+    env FJ1="$FJ1" python - "$FS" <<'PYEOF' || ft_rc=1
+import json, os, sys
+keep = ("trial_id", "params", "status", "score", "step")
+def records(p):
+    return [{k: r[k] for k in keep}
+            for r in map(json.loads, open(p).read().splitlines()[1:])]
+d = sys.argv[1]
+got = records(os.path.join(d, "tenants", os.environ["FJ1"], "ledger.jsonl"))
+want = records(os.path.join(d, "solo.jsonl"))
+assert got == want, "takeover ledger diverged from the solo run"
+ids = [r["trial_id"] for r in got]
+assert len(ids) == len(set(ids)) == 24, "a trial executed twice"
+PYEOF
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        report "$FS" --validate >/dev/null 2>&1 || ft_rc=1
+    for ck in "$FS"/tenants/*/ckpt; do
+        [ -d "$ck" ] || continue
+        timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+            fsck "$ck" >/dev/null 2>&1 || ft_rc=1
+    done
+    rm -rf "$FS"
+    if [ $ft_rc -eq 0 ]; then
+        echo "FLEET_DRILL=pass"
+    else
+        echo "FLEET_DRILL=FAIL"
         rc=$(( rc == 0 ? 1 : rc ))
     fi
 fi

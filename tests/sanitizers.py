@@ -56,7 +56,17 @@ def snapshot() -> dict:
         "observer": integrity._OBSERVER,
         "guard": shutdown._ACTIVE,
         "slice_hook": shutdown._SLICE_HOOK,
+        "beat_listener": heartbeat._LISTENER,
+        "spool_faults": _spool_faults(),
     }
+
+
+def _spool_faults():
+    # lazy import: the sanitizer must not drag the service package into
+    # every test module's import graph
+    from mpi_opt_tpu.service import spool
+
+    return spool._FAULTS
 
 
 def leaks(before: dict) -> list:
@@ -126,5 +136,16 @@ def leaks(before: dict) -> list:
         problems.append(
             "slice hook left installed — shutdown.clear_slice_hook() "
             "missing on a scheduler exit path"
+        )
+    if heartbeat._LISTENER is not before["beat_listener"]:
+        problems.append(
+            "heartbeat beat listener left installed — "
+            "heartbeat.clear_beat_listener() missing on a slice exit "
+            "path (the lease Refresher must die with its slice)"
+        )
+    if _spool_faults() is not before["spool_faults"]:
+        problems.append(
+            "spool fault injector left installed — the uninstall() from "
+            "chaos.inject_spool_faults must run in a finally"
         )
     return problems

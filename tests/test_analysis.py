@@ -424,6 +424,81 @@ def test_event_registry_shim_still_serves_test_obs():
     assert ("span", "train") in kinds
 
 
+# -- lease-write (ISSUE 12) ----------------------------------------------
+
+
+def test_lease_write_true_positive():
+    from mpi_opt_tpu.analysis.checkers_lease import LeaseWriteChecker
+
+    # direct write to a lease path in a scheduler-ish file
+    f1 = run_one(
+        LeaseWriteChecker(),
+        """
+        import json
+        def grab(t):
+            with open(t.lease, "w") as f:
+                json.dump({"server_id": "me"}, f)
+        """,
+        path="service/scheduler.py",
+    )
+    assert [f.check for f in f1] == ["lease-write"]
+    # rename onto a lease file (the tomb protocol is helper-only)
+    f2 = run_one(
+        LeaseWriteChecker(),
+        """
+        import os
+        def sneak(tmp, lease_path):
+            os.replace(tmp, lease_path)
+        """,
+        path="service/spool.py",
+    )
+    assert [f.check for f in f2] == ["lease-write"]
+    # bare unlink bypasses the token-checked release
+    f3 = run_one(
+        LeaseWriteChecker(),
+        """
+        import os
+        def drop(d):
+            os.unlink(d + "/lease.json")
+        """,
+    )
+    assert [f.check for f in f3] == ["lease-write"]
+    # os.open of a lease path (the O_EXCL create is helper-only too)
+    f4 = run_one(
+        LeaseWriteChecker(),
+        """
+        import os
+        def claim(lease_path):
+            return os.open(lease_path, os.O_CREAT | os.O_EXCL)
+        """,
+    )
+    assert [f.check for f in f4] == ["lease-write"]
+
+
+def test_lease_write_true_negative():
+    from mpi_opt_tpu.analysis.checkers_lease import LeaseWriteChecker
+
+    clean = """
+    import json, os
+    def read_side(t, path, released):
+        with open(t.lease) as f:          # reads are free
+            cur = json.load(f)
+        os.replace(path + ".tmp", path)   # non-lease replace
+        with open("release-notes.txt", "w") as f:  # `release` != lease
+            f.write("released!")
+        os.unlink(released)               # identifier word-boundary
+        return cur
+    """
+    assert run_one(LeaseWriteChecker(), clean, path="service/client.py") == []
+    # the helper module itself is the one legal writer
+    inside = """
+    import os
+    def acquire(path):
+        return os.open(path + "/lease.json", os.O_CREAT | os.O_EXCL)
+    """
+    assert run_one(LeaseWriteChecker(), inside, path="mpi_opt_tpu/service/leases.py") == []
+
+
 # -- suppression + baseline ----------------------------------------------
 
 
@@ -504,7 +579,7 @@ def test_lint_json_schema_gate(tmp_path, capsys):
     assert {c["id"] for c in rep["checks"]} == {
         "exit-code", "journal-order", "ledger-gate", "atomic-write",
         "ledger-fsync", "drain-swallow", "key-reuse", "host-sync",
-        "event-registry",
+        "event-registry", "lease-write",
     }
 
 

@@ -1,0 +1,605 @@
+"""Fleet federation (ISSUE 12): lease-based claims, zombie fencing,
+crash-safe takeover on a multi-server spool.
+
+The headline invariants under test:
+
+- lease acquisition is exclusive (O_EXCL / rename-tomb — exactly one
+  claimant ever wins, so double execution is structurally impossible);
+- an expired or dead-holder lease is taken over, and the takeover
+  resume produces a ledger record-identical to an uninterrupted solo
+  run with no trial executed twice;
+- fencing: a presumed-dead server's post-takeover writes (status at
+  slice end, lease refresh/release) are REFUSED by token
+  compare-and-check — stale pids, recycled pids, and woken zombies all
+  bounce off;
+- a server whose own identity is usurped steps down with
+  EX_UNAVAILABLE instead of fighting (zombie fencing, server edition);
+- spool metadata ops degrade to latency (bounded jittered retry) under
+  injected transient faults, and the injectors are deterministic.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from mpi_opt_tpu.cli import main
+from mpi_opt_tpu.service import leases, service_main
+from mpi_opt_tpu.service import tenants as tstates
+from mpi_opt_tpu.service.scheduler import SweepService
+from mpi_opt_tpu.service.spool import Spool, retry_io
+from mpi_opt_tpu.utils.exitcodes import EX_UNAVAILABLE
+from mpi_opt_tpu.utils.metrics import MetricsLogger
+
+
+def _quad(seed=0, trials=6):
+    return [
+        "--workload", "quadratic", "--algorithm", "random",
+        "--trials", str(trials), "--budget", "3",
+        "--workers", "1", "--seed", str(seed),
+    ]
+
+
+def _service(state_dir, **kw):
+    kw.setdefault("drain_on_empty", True)
+    kw.setdefault("poll_seconds", 0.02)
+    kw.setdefault(
+        "metrics", MetricsLogger(path=os.path.join(str(state_dir), "server-metrics.jsonl"))
+    )
+    return SweepService(str(state_dir), **kw)
+
+
+def _records(path):
+    keep = ("trial_id", "params", "status", "score", "step")
+    return [
+        {k: r[k] for k in keep}
+        for r in map(json.loads, open(path).read().splitlines()[1:])
+    ]
+
+
+def _events(state_dir, name):
+    path = os.path.join(str(state_dir), "server-metrics.jsonl")
+    return [
+        r
+        for r in map(json.loads, open(path).read().splitlines())
+        if r.get("event") == name
+    ]
+
+
+def _dead_ident(server_id="srv-dead"):
+    """A fencing identity whose holder is provably dead on this host:
+    a pid that (vanishingly likely) does not exist."""
+    return leases.ServerIdentity(
+        server_id, 2**22 + 7919, "1", leases._local_host()
+    )
+
+
+# -- lease mechanics -------------------------------------------------------
+
+
+def test_lease_acquire_is_exclusive(tmp_path):
+    lp = str(tmp_path / "lease.json")
+    a = leases.ServerIdentity.local("srv-a")
+    b = leases.ServerIdentity.local("srv-b")
+    la = leases.acquire(lp, a, ttl_s=30)
+    assert la is not None and la["server_id"] == "srv-a"
+    assert leases.acquire(lp, b, ttl_s=30) is None  # live holder wins
+    assert leases.held(lp, la)
+    assert leases.release(lp, la) is True
+    lb = leases.acquire(lp, b, ttl_s=30)
+    assert lb is not None and lb["server_id"] == "srv-b"
+    leases.release(lp, lb)
+
+
+def test_expired_lease_is_stolen_and_old_holder_is_fenced(tmp_path):
+    lp = str(tmp_path / "lease.json")
+    a = leases.ServerIdentity.local("srv-a")
+    b = leases.ServerIdentity.local("srv-b")
+    la = leases.acquire(lp, a, ttl_s=0.0)  # expires immediately
+    time.sleep(0.02)
+    lb = leases.acquire(lp, b, ttl_s=30)
+    assert lb is not None and lb["server_id"] == "srv-b"
+    # every write path the old holder has is now refused
+    assert leases.held(lp, la) is False
+    with pytest.raises(leases.LeaseFenced):
+        leases.refresh(lp, la, 30)
+    with pytest.raises(leases.LeaseFenced):
+        leases.check_fence(lp, la)
+    # a stale release must NOT unlink the new owner's lease
+    assert leases.release(lp, la) is False
+    assert leases.held(lp, lb) is True
+    leases.release(lp, lb)
+
+
+def test_dead_holder_is_taken_over_without_waiting_out_the_ttl(tmp_path):
+    """The SIGKILL fast path: a lease whose holder pid is gone (same
+    host) is expired NOW, even with hours left on its deadline."""
+    lp = str(tmp_path / "lease.json")
+    dead = leases.acquire(lp, _dead_ident(), ttl_s=99999)
+    assert dead is not None
+    assert leases.expired(leases.read_lease(lp)) is True
+    live = leases.acquire(lp, leases.ServerIdentity.local("srv-b"), ttl_s=30)
+    assert live is not None and live["server_id"] == "srv-b"
+    leases.release(lp, live)
+
+
+def test_stale_fence_refusal_after_pid_reuse(tmp_path):
+    """The kernel hands a dead server's pid to an unrelated process: the
+    pid is ALIVE, but the /proc start time tells the incarnations apart
+    — the lease is takeover-eligible, and the old incarnation's token
+    still fences."""
+    lp = str(tmp_path / "lease.json")
+    me = leases.ServerIdentity.local("srv-old")
+    # same pid as this (live) process, impossible start time: the
+    # recycled-pid shape
+    recycled = leases.ServerIdentity("srv-old", me.pid, "12345", me.host)
+    stale = leases.acquire(lp, recycled, ttl_s=99999)
+    assert stale is not None
+    assert leases.holder_dead(leases.read_lease(lp)) is True
+    assert leases.expired(leases.read_lease(lp)) is True
+    lb = leases.acquire(lp, leases.ServerIdentity.local("srv-new"), ttl_s=30)
+    assert lb is not None
+    with pytest.raises(leases.LeaseFenced):
+        leases.refresh(lp, stale, 99999)
+    assert leases.release(lp, stale) is False  # fence holds on release too
+    assert leases.held(lp, lb)
+    leases.release(lp, lb)
+
+
+def test_zombie_refresh_cannot_clobber_takers_lease(tmp_path):
+    """Review-round fix: refresh is rename-EXCLUSIVE, not
+    check-then-write — a holder that stalled past its TTL and wakes up
+    mid-refresh must not overwrite the taker's fresh lease with its
+    own token (that would re-arm the zombie and fence the rightful
+    owner). The zombie's refresh renames the file, finds a foreign
+    token, restores the taker's record byte-identically, and fences
+    ITSELF."""
+    lp = str(tmp_path / "lease.json")
+    a = leases.ServerIdentity.local("srv-a")
+    la = leases.acquire(lp, a, ttl_s=0.0)
+    time.sleep(0.02)
+    lb = leases.acquire(lp, leases.ServerIdentity.local("srv-b"), ttl_s=30)
+    assert lb is not None
+    before = leases.read_lease(lp)
+    with pytest.raises(leases.LeaseFenced):
+        leases.refresh(lp, la, 30)
+    assert leases.read_lease(lp) == before  # restored, not clobbered
+    assert leases.held(lp, lb)
+    leases.release(lp, lb)
+
+
+def test_unreadable_lease_is_stealable(tmp_path):
+    """A torn lease file (crashed writer) must not wedge the job
+    forever: unreadable == expired for acquisition."""
+    lp = str(tmp_path / "lease.json")
+    open(lp, "w").write("{torn")
+    lease = leases.acquire(lp, leases.ServerIdentity.local("srv-a"), ttl_s=30)
+    assert lease is not None
+    leases.release(lp, lease)
+
+
+def test_lease_refresh_rides_heartbeat_beats(tmp_path):
+    """The Refresher installed as the beat listener keeps a
+    shorter-than-the-test TTL alive purely off heartbeat traffic —
+    the lease-refresh-rides-heartbeats contract, end to end."""
+    from mpi_opt_tpu.health import heartbeat
+
+    ident = leases.ServerIdentity.local("srv-a")
+    lp = str(tmp_path / "lease.json")
+    lease = leases.acquire(lp, ident, ttl_s=0.2)
+    refresher = leases.Refresher(lp, lease, 0.2)
+    hb = heartbeat.Heartbeat(str(tmp_path / "hb.json"))
+    heartbeat.set_beat_listener(refresher)
+    try:
+        deadline = time.monotonic() + 0.8
+        while time.monotonic() < deadline:
+            hb.beat(stage="train")
+            time.sleep(0.02)
+    finally:
+        heartbeat.clear_beat_listener()
+    cur = leases.read_lease(lp)
+    assert cur["refreshes"] >= 3  # throttled to ttl/3, not per-beat
+    assert leases.expired(cur) is False  # 0.8s wall >> 0.2s ttl
+    leases.release(lp, refresher.lease)
+
+
+def test_refresher_stop_settles_and_disables(tmp_path):
+    """Review-round fix: the end-of-slice fence/release must judge a
+    SETTLED lease file — stop() blocks out any in-flight refresh and
+    disables future ones, so a straggler beat from a staging thread
+    that outlived its join can never reopen the refresh absence window
+    under the fence's feet (or re-create a lease nobody releases)."""
+    lp = str(tmp_path / "lease.json")
+    ident = leases.ServerIdentity.local("srv-a")
+    lease = leases.acquire(lp, ident, ttl_s=10)
+    refresher = leases.Refresher(lp, lease, 10)
+    final = refresher.stop()
+    assert final["token"] == lease["token"]
+    refresher._next = 0.0  # even a due refresh is a no-op after stop
+    refresher()
+    assert leases.read_lease(lp)["refreshes"] == 0
+    assert leases.release(lp, final) is True
+
+
+def test_refresher_fences_once_and_requests_drain(tmp_path):
+    lp = str(tmp_path / "lease.json")
+    a = leases.ServerIdentity.local("srv-a")
+    la = leases.acquire(lp, a, ttl_s=0.0)
+    time.sleep(0.02)
+    lb = leases.acquire(lp, leases.ServerIdentity.local("srv-b"), ttl_s=30)
+    assert lb is not None
+    fired = []
+    refresher = leases.Refresher(lp, la, 0.0, on_fenced=lambda: fired.append(1))
+    refresher()
+    refresher()
+    refresher()
+    assert refresher.fenced is True
+    assert fired == [1]  # latched: the drain request fires exactly once
+    leases.release(lp, lb)
+
+
+# -- spool I/O robustness (retry + seeded chaos) ---------------------------
+
+
+def test_retry_io_absorbs_transient_and_respects_answers():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError(5, "injected EIO")
+        return "ok"
+
+    assert retry_io(flaky, sleep=lambda s: None) == "ok"
+    assert len(calls) == 3
+
+    def exists():
+        raise FileExistsError("O_EXCL lost the race")
+
+    with pytest.raises(FileExistsError):  # an answer, not a fault: no retry
+        retry_io(exists, sleep=lambda s: None)
+
+    def always():
+        raise OSError(5, "persistent EIO")
+
+    with pytest.raises(OSError):  # bounded: the last error propagates raw
+        retry_io(always, attempts=3, sleep=lambda s: None)
+
+
+def test_spool_faults_absorbed_by_retry_then_surface_past_budget(tmp_path):
+    from mpi_opt_tpu.workloads.chaos import inject_spool_faults
+
+    spool = Spool(str(tmp_path))
+    inj, uninstall = inject_spool_faults(replace_fail=2)
+    try:
+        job = spool.submit(_quad(0))  # 2 transient failures -> latency only
+    finally:
+        uninstall()
+    assert inj.faults_fired["replace"] == 2
+    assert spool.pending_jobs() and job
+
+    inj, uninstall = inject_spool_faults(replace_fail=50)
+    try:
+        with pytest.raises(OSError):  # persistent: surfaces after budget
+            spool.submit(_quad(1))
+    finally:
+        uninstall()
+
+
+def test_spool_read_eio_on_status_reads_is_absorbed(tmp_path):
+    from mpi_opt_tpu.workloads.chaos import inject_spool_faults
+
+    spool = Spool(str(tmp_path))
+    spool.submit(_quad(0))
+    t = spool.admit(spool.pending_jobs()[0])
+    inj, uninstall = inject_spool_faults(read_fail=2)
+    try:
+        s = t.status  # 2 EIOs absorbed by the bounded retry
+    finally:
+        uninstall()
+    assert s.get("state") == tstates.QUEUED
+    assert inj.faults_fired["read"] == 2
+
+
+def test_spool_fault_injector_is_deterministic():
+    from mpi_opt_tpu.workloads.chaos import SpoolFaultInjector
+
+    a = SpoolFaultInjector(replace_fail=3, seed=7, ops_window=20)
+    b = SpoolFaultInjector(replace_fail=3, seed=7, ops_window=20)
+    assert a._fail == b._fail and len(a._fail["replace"]) == 3
+    c = SpoolFaultInjector(replace_fail=3, seed=8, ops_window=20)
+    assert a._fail != c._fail  # the seed picks WHICH ops fault
+    # first-N mode needs no window and fires in order
+    d = SpoolFaultInjector(replace_fail=2)
+    assert d._fail["replace"] == frozenset({0, 1})
+    # non-status reads are out of scope and do not consume ordinals
+    e = SpoolFaultInjector(read_fail=1)
+    e("read", "/spool/tenants/j/job.json")  # ignored
+    with pytest.raises(OSError):
+        e("read", "/spool/tenants/j/status.json")
+
+
+# -- the acceptance spine: takeover, record-identical, nothing twice -------
+
+
+def test_takeover_resumes_to_solo_identical_ledger(tmp_path, capsys):
+    """A tenant mid-sweep on server A; A dies the SIGKILL way (forged:
+    status still ``running``, lease held by a dead incarnation).
+    Survivor B claims the expired lease, resumes via the ordinary
+    verified-snapshot + journal-prefix machinery, and finishes with a
+    ledger record-identical to an uninterrupted solo run — no trial
+    executed twice, takeover counted on the job."""
+    d = tmp_path / "svc"
+    spool = Spool(str(d))
+    job = spool.submit(_quad(0, trials=8), tenant="alice")
+
+    def drain_mid_slice(t, stage, n):
+        if n == 3:
+            spool.request_drain()
+
+    svcA = _service(
+        d, server_id="srv-a", slice_boundaries=100, on_boundary=drain_mid_slice
+    )
+    assert svcA.serve() == 0
+    t = spool.tenant(job)
+    assert t.status["state"] == tstates.PARKED
+    assert len(_records(t.ledger)) == 3  # mid-sweep: durable progress exists
+
+    # forge the SIGKILL shape: running status + a dead holder's lease
+    t.write_status(dict(t.status, state=tstates.RUNNING, server="srv-a"))
+    assert leases.acquire(t.lease, _dead_ident("srv-a"), ttl_s=99999) is not None
+
+    svcB = _service(d, server_id="srv-b", slice_boundaries=100)
+    assert svcB.serve() == 0
+    st = spool.tenant(job).status
+    assert st["state"] == tstates.DONE
+    assert st["takeovers"] == 1
+    assert st["server"] == "srv-b"
+    (ev,) = _events(d, "tenant_takeover")
+    assert ev["job"] == job and ev["from_server"] == "srv-a"
+    assert ev["to_server"] == "srv-b"
+
+    solo = str(tmp_path / "solo.jsonl")
+    assert main(_quad(0, trials=8) + ["--ledger", solo]) == 0
+    capsys.readouterr()
+    got, want = _records(t.ledger), _records(solo)
+    assert got == want, "takeover ledger diverged from solo run"
+    # structural no-double-execution: every trial id appears exactly once
+    ids = [r["trial_id"] for r in got]
+    assert len(ids) == len(set(ids)) == 8
+    # the report surface says the handoff happened (ledger/report.py)
+    assert main(["report", t.ledger]) == 0
+    out = capsys.readouterr().out
+    assert "takeovers=1" in out and "server=srv-b" in out
+    assert main(["report", "--validate", t.ledger]) == 0
+    capsys.readouterr()
+
+
+def test_fenced_zombie_slice_writes_are_refused(tmp_path):
+    """The dead-server's-post-kill-writes drill: server A's lease is
+    stolen MID-SLICE (as a takeover after A was presumed dead would).
+    A's refresher fences at the next boundary, the slice drains, and
+    A's end-of-slice status write is REFUSED — the thief's lease and
+    the tenant record stay untouched by the zombie."""
+    d = tmp_path / "svc"
+    spool = Spool(str(d))
+    job = spool.submit(_quad(0, trials=40), tenant="alice")
+    thief = leases.ServerIdentity.local("srv-thief")
+    stolen = {}
+
+    svcA = _service(d, server_id="srv-a", slice_boundaries=100, lease_ttl=0.05)
+
+    def steal_mid_slice(t, stage, n):
+        if n == 2:
+            # A's 0.05s ttl has lapsed by the time boundary 2 arrives:
+            # the thief takes over exactly as a live peer would
+            time.sleep(0.06)
+            lease = leases.acquire(t.lease, thief, ttl_s=9999)
+            assert lease is not None, "thief must win the expired lease"
+            stolen.update(lease)
+
+    svcA.on_boundary = steal_mid_slice
+    svcA._admit_pending()
+    t = spool.tenant(job)
+    pick = svcA._pick_next()
+    assert pick is not None and pick[0].job_id == job
+    running_before = dict(t.status)
+    assert svcA._run_slice(pick[0], pick[1]) is None
+    # the zombie never wrote: status is exactly the RUNNING record A
+    # wrote at slice start (no slices/boundaries/rc accounting landed)
+    after = t.status
+    assert after["state"] == tstates.RUNNING
+    assert after["slices"] == running_before["slices"] == 0
+    assert "rc_history" in after and after["rc_history"] == []
+    # and the slice drained early: fenced within a few refresh windows
+    # of the steal, nowhere near the sweep's 40 trials
+    (fenced,) = _events(d, "slice_fenced")
+    assert fenced["job"] == job and fenced["boundaries"] < 40
+    # the thief's lease survived A's exit paths (release was refused)
+    assert leases.held(t.lease, stolen) is True
+    leases.release(t.lease, stolen)
+
+
+# -- fleet scheduling races ------------------------------------------------
+
+
+def test_concurrent_pick_only_one_server_wins(tmp_path):
+    spool = Spool(str(tmp_path))
+    job = spool.submit(_quad(0), tenant="alice")
+    svcA = _service(tmp_path, server_id="srv-a")
+    svcB = _service(tmp_path, server_id="srv-b")
+    svcA._admit_pending()
+    pick = svcA._pick_next()
+    assert pick is not None and pick[0].job_id == job
+    assert svcB._pick_next() is None  # B skips the leased job, never blocks
+    leases.release(pick[0].lease, pick[1])
+    pick_b = svcB._pick_next()
+    assert pick_b is not None and pick_b[0].job_id == job
+    leases.release(pick_b[0].lease, pick_b[1])
+
+
+def test_duplicate_admission_cannot_reset_a_running_tenant(tmp_path):
+    """Two servers race the same queue file: the slow peer re-runs
+    _materialize AFTER the fast one's tenant already started running.
+    The initial-status write is create-if-absent, so the duplicate
+    admission is a no-op on state."""
+    import shutil
+
+    spool = Spool(str(tmp_path))
+    spool.submit(_quad(0), tenant="alice")
+    qpath = spool.pending_jobs()[0]
+    stash = qpath + ".stash"
+    shutil.copy(qpath, stash)
+    t = spool.admit(qpath)
+    t.write_status(dict(t.status, state=tstates.RUNNING, slices=1))
+    shutil.copy(stash, qpath)  # the slow peer still "sees" the queue file
+    t2 = Spool(str(tmp_path)).admit(qpath)
+    assert t2.job_id == t.job_id
+    s = t.status
+    assert s["state"] == tstates.RUNNING and s["slices"] == 1  # not reset
+
+
+def test_queue_cancel_defers_to_a_live_foreign_lease(tmp_path):
+    """Cancelling a parked job a peer just leased: the cancel write is
+    refused (the peer would race it) and the flag is honored at the
+    peer's own boundary instead; once the lease frees, cancel lands."""
+    spool = Spool(str(tmp_path))
+    job = spool.submit(_quad(0), tenant="alice")
+    svc = _service(tmp_path, server_id="srv-b")
+    svc._admit_pending()
+    t = spool.tenant(job)
+    peer = leases.acquire(t.lease, leases.ServerIdentity.local("srv-a"), 30)
+    assert peer is not None
+    t.request_cancel()
+    svc._apply_queued_cancels()
+    assert t.status["state"] == tstates.QUEUED  # deferred, not raced
+    leases.release(t.lease, peer)
+    svc._status_memo.clear()
+    svc._tenants_memo = None
+    svc._apply_queued_cancels()
+    assert t.status["state"] == tstates.CANCELLED
+
+
+def test_two_servers_share_one_spool_and_split_the_queue(tmp_path):
+    """The cooperative (no-failure) fleet shape: two servers run the
+    same spool SEQUENTIALLY-sliced but lease-arbitrated — every job
+    finishes exactly once even though both servers saw every job."""
+    spool = Spool(str(tmp_path))
+    # trials == slice budget: each job completes in ONE slice, so the
+    # strict A/B hand-interleave below lands whole jobs on each server
+    jobs = [spool.submit(_quad(s, trials=2), tenant=f"t{s}") for s in range(3)]
+    svcA = _service(tmp_path, server_id="srv-a", slice_boundaries=2)
+    svcB = _service(tmp_path, server_id="srv-b", slice_boundaries=2)
+    # interleave the two servers' scheduling loops by hand (in-process
+    # threads would fight over the module-global slice hook; the lease
+    # protocol is filesystem-level and does not care who calls it)
+    for _ in range(40):
+        for svc in (svcA, svcB):
+            svc._status_memo.clear()
+            svc._tenants_memo = None
+            svc._admit_pending()
+            pick = svc._pick_next()
+            if pick is not None:
+                svc._run_slice(pick[0], pick[1], pick[2])
+        if all(
+            t.status.get("state") in tstates.TERMINAL for t in spool.tenants()
+        ):
+            break
+    states = {t.job_id: t.status for t in spool.tenants()}
+    assert all(states[j]["state"] == tstates.DONE for j in jobs)
+    # both servers did real work on a shared spool (slice events carry
+    # the server id so fleet activity is attributable post-hoc)
+    servers_used = {e["server"] for e in _events(tmp_path, "slice_end")}
+    assert servers_used == {"srv-a", "srv-b"}
+    assert {states[j].get("server") for j in jobs} <= servers_used
+    for j in jobs:
+        ids = [r["trial_id"] for r in _records(spool.tenant(j).ledger)]
+        assert len(ids) == len(set(ids)) == 2  # nothing ran twice
+
+
+# -- server identity usurpation (zombie fencing, server edition) -----------
+
+
+def test_usurped_server_steps_down_with_unavailable(tmp_path):
+    from mpi_opt_tpu.service.spool import _write_json_atomic
+
+    spool = Spool(str(tmp_path))
+    spool.submit(_quad(0, trials=8), tenant="alice")
+    svc = _service(tmp_path, server_id="srv-a", slice_boundaries=2)
+
+    def usurp(t, stage, n):
+        if n == 1:
+            rec = json.loads(open(spool.server_file("srv-a")).read())
+            _write_json_atomic(
+                spool.server_file("srv-a"),
+                dict(rec, pid_start="999", pid=2**22 + 7919),
+            )
+            svc._server_refresh_next = 0.0  # force the next loop's check
+
+    svc.on_boundary = usurp
+    assert svc.serve() == EX_UNAVAILABLE
+    assert _events(tmp_path, "server_usurped")
+    # the parting clear_server must NOT unlink the usurper's file
+    rec = json.loads(open(spool.server_file("srv-a")).read())
+    assert rec["pid_start"] == "999"
+    # ...and the tenant it was running parked cleanly at the boundary
+    # (the lease was still ours; only the IDENTITY was lost)
+    (t,) = spool.tenants()
+    assert t.status["state"] == tstates.PARKED
+    # a restarted server under a fresh id finishes the work
+    assert _service(tmp_path, server_id="srv-fresh").serve() == 0
+    assert t.status["state"] == tstates.DONE
+
+
+# -- fleet status surfaces -------------------------------------------------
+
+
+def test_status_renders_fleet_table(tmp_path, capsys):
+    spool = Spool(str(tmp_path))
+    job = spool.submit(_quad(0), tenant="alice")
+    t = spool.admit(spool.pending_jobs()[0])
+    t.write_status(dict(t.status, state=tstates.RUNNING, server="srv-a", takeovers=1))
+    spool.write_server("srv-a", lease_ttl=30, takeovers=1)  # live: us
+    # a dead fleet member, visible as evidence
+    from mpi_opt_tpu.service.spool import _write_json_atomic
+
+    _write_json_atomic(
+        spool.server_file("srv-b"),
+        {"server_id": "srv-b", "pid": 2**22 + 7919, "pid_start": "1",
+         "host": leases._local_host(), "ts": time.time() - 300},
+    )
+    # an EXPIRED lease on the running job: the orphan-awaiting-takeover shape
+    assert leases.acquire(t.lease, _dead_ident("srv-a"), ttl_s=99999) is not None
+
+    assert service_main(["status", "--state-dir", str(tmp_path), "--json"]) == 0
+    st = json.loads(capsys.readouterr().out)
+    by_id = {s["server_id"]: s for s in st["servers"]}
+    assert by_id["srv-a"]["alive"] is True
+    assert by_id["srv-a"]["takeovers"] == 1
+    assert by_id["srv-a"]["refreshed_age_s"] is not None
+    assert by_id["srv-b"]["alive"] is False
+    assert st["server"]["alive"] is True  # aggregate: any live member
+    (j,) = st["jobs"]
+    assert j["job"] == job and j["server"] == "srv-a" and j["takeovers"] == 1
+    assert j["lease"]["live"] is False  # dead holder: takeover pending
+
+    assert service_main(["status", "--state-dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "1/2 servers up" in out
+    assert "server srv-b  DEAD" in out
+    assert "takeovers=1" in out
+    assert "lease=EXPIRED" in out
+
+
+def test_serve_flag_validation(tmp_path):
+    from mpi_opt_tpu.service.client import serve_main
+
+    for argv in (
+        ["--state-dir", str(tmp_path), "--lease-ttl", "0"],
+        ["--state-dir", str(tmp_path), "--server-id", "bad/id"],
+        ["--state-dir", str(tmp_path), "--server-id", ""],
+    ):
+        with pytest.raises(SystemExit) as e:
+            serve_main(argv)
+        assert e.value.code == 2

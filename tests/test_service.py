@@ -86,6 +86,7 @@ def test_exitcodes_single_home():
     assert exitcodes.classify(0) == "ok"
     assert exitcodes.classify(2) == "usage"
     assert exitcodes.classify(65) == "data_error"
+    assert exitcodes.classify(69) == "unavailable"
     assert exitcodes.classify(75) == "preempted"
     assert exitcodes.classify(1) == "failure"
     assert exitcodes.classify(137) == "failure"
@@ -198,13 +199,20 @@ def test_submit_status_cancel_roundtrip(tmp_path, capsys):
     capsys.readouterr()
 
 
-def test_serve_refuses_second_server(tmp_path):
+def test_serve_refuses_second_server_with_same_id(tmp_path):
+    """The default server-id deliberately collides: two default-id
+    servers refuse each other (preserving one-server-per-spool until
+    the operator federates with distinct --server-id values)."""
     from mpi_opt_tpu.service.spool import ServerClaimError
 
     spool = Spool(str(tmp_path))
-    spool.write_server()  # this live process "is" the server
-    with pytest.raises(ServerClaimError, match="one device, one server"):
+    spool.write_server()  # this live process "is" the default server
+    with pytest.raises(ServerClaimError, match="federate with a distinct"):
         _service(tmp_path).serve()
+    # a DISTINCT id registers fine beside the live default one
+    assert spool.register_server("srv-b") is True
+    assert {s["server_id"] for s in spool.read_servers()} == {"server", "srv-b"}
+    spool.clear_server("srv-b")
     spool.clear_server()
 
 
@@ -217,7 +225,7 @@ def test_serve_main_masks_only_claim_refusals(tmp_path, monkeypatch, capsys):
 
     Spool(str(tmp_path)).write_server()  # live claim -> refusal path
     assert service_main(["serve", "--state-dir", str(tmp_path)]) == EX_USAGE
-    assert "one device, one server" in capsys.readouterr().err
+    assert "already owns server-id" in capsys.readouterr().err
     Spool(str(tmp_path)).clear_server()
 
     def crash(self):
@@ -434,8 +442,9 @@ def test_sigkill_shaped_death_recovers_on_restart(tmp_path, capsys):
     ).serve() == 0
     t = spool.tenant(job)
     assert t.status["state"] == tstates.PARKED
-    # forge the kill shape: status says running, server.json names a
-    # pid that no longer exists
+    # forge the kill shape: status says running, the registration names
+    # a pid that no longer exists, and no (or a dead-holder) lease —
+    # the restarted server claims the orphan's lease and resumes it
     t.write_status(dict(t.status, state=tstates.RUNNING))
     spool.write_server()
     srv = spool.read_server()
@@ -448,7 +457,8 @@ def test_sigkill_shaped_death_recovers_on_restart(tmp_path, capsys):
     assert _service(tmp_path, slice_boundaries=100).serve() == 0
     st = spool.tenant(job).status
     assert st["state"] == tstates.DONE
-    assert any(e["job"] == job for e in _events(tmp_path, "tenant_recovered"))
+    assert any(e["job"] == job for e in _events(tmp_path, "tenant_takeover"))
+    assert st["takeovers"] == 1
     solo = str(tmp_path / "solo.jsonl")
     assert main(_quad(0, trials=6) + ["--ledger", solo]) == 0
     capsys.readouterr()
@@ -575,7 +585,9 @@ def test_fair_share_usage_is_session_scoped(tmp_path):
     assert restarted._usage.get("alice", 0) == 0
     assert restarted._usage["bob"] == 3
     # alice (0) outranks bob (3): her new job is picked immediately
-    assert restarted._pick_next().job_id == a_new
+    # (_pick_next now also ACQUIRES the pick's lease — fleet arbitration)
+    picked, lease, takeover_from = restarted._pick_next()
+    assert picked.job_id == a_new and lease is not None and takeover_from is None
 
 
 def test_server_alive_counts_eperm_as_alive(tmp_path, monkeypatch):
@@ -606,19 +618,26 @@ def test_read_summary_scoped_to_this_slice(tmp_path):
     assert _read_summary(str(log), start) is None
 
 
-def test_claim_server_is_atomic_and_breaks_stale_claims(tmp_path):
-    """One-server-per-spool is an O_EXCL claim, not a check-then-write:
-    a live claim refuses peers, a dead pid's claim is broken."""
+def test_register_server_is_atomic_and_breaks_stale_registrations(tmp_path):
+    """One-process-per-server-id is an O_EXCL claim, not a
+    check-then-write: a live registration refuses peers, a dead pid's
+    registration is broken."""
     spool = Spool(str(tmp_path))
-    assert spool.claim_server() is True
-    assert Spool(str(tmp_path)).claim_server() is False  # we are alive
+    assert spool.register_server() is True
+    assert Spool(str(tmp_path)).register_server() is False  # we are alive
     spool.clear_server()
-    # stale claim: dead pid
+    # stale registration: dead pid
     spool.write_server()
     srv = json.loads(open(spool.server_path).read())
     srv["pid"] = 2**22 + 7919
     open(spool.server_path, "w").write(json.dumps(srv))
-    assert spool.claim_server() is True
+    assert spool.register_server() is True
+    # refresh is token-checked against THIS process: ours refreshes,
+    # and a file rewritten by someone else refuses (the step-down cue)
+    assert spool.refresh_server(Spool.DEFAULT_SERVER_ID, takeovers=3) is True
+    assert spool.read_server()["takeovers"] == 3
+    open(spool.server_path, "w").write(json.dumps(dict(srv, pid_start="999")))
+    assert spool.refresh_server(Spool.DEFAULT_SERVER_ID) is False
 
 
 def test_stale_claim_with_recycled_pid_is_broken(tmp_path):
@@ -637,7 +656,7 @@ def test_stale_claim_with_recycled_pid_is_broken(tmp_path):
     # claim was written by a previous incarnation of that pid
     _write_json_atomic(spool.server_path, dict(info, pid_start="12345"))
     assert spool.server_alive() is False
-    assert spool.claim_server() is True
+    assert spool.register_server() is True
     spool.clear_server()
 
 
@@ -876,16 +895,19 @@ def test_signal_between_loop_check_and_slice_never_burns_a_quantum(tmp_path):
     window ticks down."""
     from mpi_opt_tpu.health import shutdown
 
+    from mpi_opt_tpu.service import leases
+
     spool = Spool(str(tmp_path))
     spool.submit(_quad(), tenant="a")
     svc = _service(tmp_path)
     (qpath,) = spool.pending_jobs()
     t = spool.admit(qpath)
+    lease = leases.acquire(t.lease, svc.ident, svc.lease_ttl)
     shutdown.clear_delivered()
     try:
         with shutdown.ShutdownGuard() as g:  # the server's guard
             g._handle(signal.SIGTERM, None)  # the race: signal pre-slice
-            assert svc._run_slice(t) == "SIGTERM"
+            assert svc._run_slice(t, lease) == "SIGTERM"
         # the tenant never ran: no slice accounting, still runnable
         assert t.status["state"] == tstates.QUEUED
         assert int(t.status.get("slices") or 0) == 0
