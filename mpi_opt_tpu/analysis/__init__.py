@@ -51,6 +51,7 @@ def all_checkers():
     from mpi_opt_tpu.analysis.checkers_jax import HostSyncChecker, KeyReuseChecker
     from mpi_opt_tpu.analysis.checkers_lease import LeaseWriteChecker
     from mpi_opt_tpu.analysis.checkers_registry import EventRegistryChecker
+    from mpi_opt_tpu.analysis.checkers_resources import ResourceFunnelChecker
 
     return [
         ExitCodeChecker(),
@@ -63,4 +64,5 @@ def all_checkers():
         HostSyncChecker(),
         EventRegistryChecker(),
         LeaseWriteChecker(),
+        ResourceFunnelChecker(),
     ]

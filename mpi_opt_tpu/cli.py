@@ -23,8 +23,8 @@ from mpi_opt_tpu.health import heartbeat as _heartbeat
 from mpi_opt_tpu.health import shutdown as _shutdown
 from mpi_opt_tpu.obs import trace as _trace
 from mpi_opt_tpu.ops.pbt import PBTConfig
-from mpi_opt_tpu.utils import integrity
-from mpi_opt_tpu.utils.exitcodes import EX_DATAERR, EX_TEMPFAIL
+from mpi_opt_tpu.utils import integrity, resources
+from mpi_opt_tpu.utils.exitcodes import EX_DATAERR, EX_IOERR, EX_TEMPFAIL
 from mpi_opt_tpu.utils.integrity import NoVerifiedSnapshotError
 from mpi_opt_tpu.utils.metrics import stdout_logger
 from mpi_opt_tpu.workloads import available, get_workload
@@ -44,6 +44,48 @@ def _wire_integrity_observer(metrics):
             metrics.count_quarantined()
 
     integrity.set_observer(observe)
+
+
+def _wire_resource_observer(metrics):
+    """Route resource-exhaustion events (utils/resources.py) into this
+    run's metrics stream: oom_backoff / wave_resized / snapshot_pruned
+    become logged events plus their summary counters. Process-global
+    like the integrity observer (the wave scheduler and checkpoint
+    layer run deep inside fused sweeps, far from any metrics handle);
+    main() clears it on the way out."""
+
+    def observe(event, **fields):
+        metrics.log(event, **fields)
+        if event == "oom_backoff":
+            metrics.count_oom_backoffs()
+        elif event == "wave_resized":
+            metrics.count_wave_resized()
+        elif event == "snapshot_pruned":
+            metrics.count_pruned()
+
+    resources.set_observer(observe)
+
+
+def _resource_exit(e, metrics, kind: str, **summary_fields) -> int:
+    """The resource-exhaustion park (utils/resources.py): a device OOM
+    with no wave left to halve, or a disk still full after the one
+    retention-prune retry. Durable state is INTACT (unlike exit 65 —
+    the failed write never landed and the newest verified step was
+    never touched), but a retry without operator action re-fails
+    identically — so exit EX_IOERR (74): launch.py aborts with
+    diagnostics, budget untouched; the service scheduler PARKS the
+    tenant, and freeing the resource + ``--resume`` recovers."""
+    metrics.summary(final=True)
+    print(json.dumps({"resource_exhausted": str(e), "kind": kind, **summary_fields}))
+    hint = (
+        "free disk space, then relaunch with --resume"
+        if kind == "storage_full"
+        else "reduce residency: --wave-size auto (wave mode backs off "
+        "automatically via --oom-backoff), smaller --population, or "
+        "--member-chunk"
+    )
+    print(f"{e}\n({hint}; exit {EX_IOERR})", file=sys.stderr)
+    return EX_IOERR
 
 
 def _data_error_exit(e, metrics, **summary_fields) -> int:
@@ -250,6 +292,18 @@ def build_parser() -> argparse.ArgumentParser:
         "to resident mode on the CPU backend (tested); see README "
         "'Wave scheduling'",
     )
+    p.add_argument(
+        "--oom-backoff",
+        type=int,
+        default=2,
+        metavar="N",
+        help="fused pbt wave mode: on a device OOM (XLA "
+        "RESOURCE_EXHAUSTED), automatically halve the wave size and "
+        "re-run the generation — bit-identical at any wave size — up "
+        "to N times (0 disables). Also pre-clamps an explicit "
+        "--wave-size against the measured device budget. Resident-mode "
+        "and post-budget OOMs exit 74 (classified, non-retryable)",
+    )
     # multi-host bring-up (SURVEY.md §2 row 1 + §5): the reference's
     # ``mpirun`` launch WAS its user surface; the CLI owns SPMD bring-up
     # the same way — one OS process per host, each invoking this CLI
@@ -425,6 +479,7 @@ def _is_transient(e: BaseException) -> bool:
     'deadline') from being retried N times (ADVICE r4)."""
     import jax.errors
 
+    # sweeplint: disable=resource-funnel -- deliberate: this is the TRANSIENT platform-death classifier (crashed/unavailable/deadline), disjoint from the OOM funnel — its markers exclude RESOURCE_EXHAUSTED, and DeviceOOM never reaches here (classified before the retry loop)
     if not isinstance(e, (jax.errors.JaxRuntimeError, OSError)):
         return False
     return any(m in str(e).lower() for m in _TRANSIENT_MARKERS)
@@ -643,6 +698,7 @@ def run_fused(args, parser, workload) -> int:
     n_chips = int(mesh.devices.size) if mesh is not None else 1
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
     _wire_integrity_observer(metrics)
+    _wire_resource_observer(metrics)
     _wire_trace(args, metrics)  # restored by main's finally
     from mpi_opt_tpu.ledger import LedgerError
 
@@ -664,8 +720,35 @@ def run_fused(args, parser, workload) -> int:
     ledger = _open_fused_ledger(args, parser, space, metrics)
     t0 = time.perf_counter()
     try:
-        return _run_fused_dispatch(
-            args, parser, workload, mesh, n_chips, metrics, t0, ledger, warm_obs
+        # the fused launch path's device-OOM classification boundary:
+        # any driver's XLA RESOURCE_EXHAUSTED arrives here as ONE type
+        with resources.oom_funnel():
+            return _run_fused_dispatch(
+                args, parser, workload, mesh, n_chips, metrics, t0, ledger, warm_obs
+            )
+    except resources.DeviceOOM as e:
+        # deterministic for this program+population: retrying the same
+        # shape re-OOMs (wave mode already spent its --oom-backoff
+        # budget before this propagates) — park classified, exit 74
+        return _resource_exit(
+            e,
+            metrics,
+            "device_oom",
+            workload=args.workload,
+            algorithm=args.algorithm,
+            backend="fused",
+        )
+    except resources.StorageFull as e:
+        # the disk filled mid-snapshot/journal after the one
+        # retention-prune retry: durable state intact, free disk +
+        # --resume recovers — park classified, exit 74
+        return _resource_exit(
+            e,
+            metrics,
+            "storage_full",
+            workload=args.workload,
+            algorithm=args.algorithm,
+            backend="fused",
         )
     except (NoVerifiedSnapshotError, LedgerError) as e:
         # both are data dead-ends: an unverifiable snapshot tree, or a
@@ -822,6 +905,7 @@ def _run_fused_dispatch(
                 snapshot_every=args.checkpoint_every,
                 ledger=ledger,
                 warm_obs=warm_obs,
+                oom_backoff=args.oom_backoff,
             ), args.retries, metrics)
             n_trials = args.population * args.generations
             extra = {"best_curve": [round(float(v), 4) for v in res["best_curve"]]}
@@ -834,6 +918,7 @@ def _run_fused_dispatch(
                     staged_bytes=res["staged_bytes"],
                     stage_overlap_s=round(res["stage_overlap_s"], 3),
                     stage_wait_s=round(res["stage_wait_s"], 3),
+                    oom_backoffs=res.get("oom_backoffs", 0),
                 )
         elif args.algorithm in ("asha", "random"):
             from mpi_opt_tpu.train.fused_asha import fused_sha
@@ -1037,6 +1122,8 @@ def main(argv=None, *, _workload=None) -> int:
             )
         if args.wave_size < 0:
             parser.error(f"--wave-size must be >= 0, got {args.wave_size}")
+    if args.oom_backoff < 0:
+        parser.error(f"--oom-backoff must be >= 0, got {args.oom_backoff}")
     if args.wave_size:
         if not args.fused or args.algorithm != "pbt":
             parser.error(
@@ -1131,6 +1218,7 @@ def main(argv=None, *, _workload=None) -> int:
     finally:
         _heartbeat.deconfigure()
         integrity.clear_observer()
+        resources.clear_observer()
         _trace.deconfigure(trace_entry)
 
 
@@ -1193,6 +1281,7 @@ def _run_sweep(args, parser, _workload=None) -> int:
     # setup span — it is most of a driver sweep's time-to-first-trial
     metrics = stdout_logger(path=args.metrics_file, n_chips=n_chips)
     _wire_integrity_observer(metrics)
+    _wire_resource_observer(metrics)
     _wire_trace(args, metrics)  # restored by main's finally
     with _trace.span("setup", backend=args.backend) as _setup_sp:
         # device kind keys the roofline's platform-cap calibration
@@ -1319,6 +1408,18 @@ def _run_sweep(args, parser, _workload=None) -> int:
                 policy=policy,
                 ledger=ledger,
             )
+    except resources.StorageFull as e:
+        # classified disk-full during a ledger fsync or checkpoint save
+        # (after its one retention-prune retry): durable state intact,
+        # exit 74 — free disk + --resume recovers
+        return _resource_exit(
+            e,
+            metrics,
+            "storage_full",
+            workload=args.workload,
+            algorithm=args.algorithm,
+            backend=args.backend,
+        )
     except SweepAborted as e:
         # the circuit breaker tripping is an OPERATOR outcome, not a
         # crash: summarize the counters that tripped it and exit nonzero

@@ -81,7 +81,7 @@ import time
 
 from mpi_opt_tpu.health.shutdown import ShutdownGuard
 from mpi_opt_tpu.health.watchdog import StallDetector
-from mpi_opt_tpu.utils.exitcodes import EX_DATAERR, EX_TEMPFAIL, EX_USAGE
+from mpi_opt_tpu.utils.exitcodes import EX_DATAERR, EX_IOERR, EX_TEMPFAIL, EX_USAGE
 
 
 def _backoff_s(attempt: int, base: float, jitter: float, rng: random.Random) -> float:
@@ -610,6 +610,30 @@ def main(argv=None) -> int:
                     "`mpi_opt_tpu fsck` on the checkpoint dir, then "
                     "restart without --resume or point at fresh state. "
                     f"Stderr:\n{tail}\n"
+                )
+                return 1
+            if rc == EX_IOERR:
+                # resource exhaustion, classified (utils/resources.py):
+                # device OOM with no wave left to halve, or a disk
+                # still full after the retention-prune retry. The
+                # state is intact — but a restart changes NOTHING
+                # until an operator frees the resource, so retrying
+                # burns the whole budget re-failing identically.
+                # Abort with diagnostics, budget untouched.
+                _event(
+                    "failed",
+                    rank=failed,
+                    returncode=rc,
+                    attempts=attempt + 1,
+                    resource_exhausted=True,
+                )
+                sys.stderr.write(
+                    f"rank {failed} exited {EX_IOERR} (EX_IOERR): device "
+                    "or storage exhaustion — not retrying a resource "
+                    "error. Free the resource (disk space; or reduce "
+                    "residency via --wave-size auto / --population), "
+                    "then relaunch with --resume to continue from the "
+                    f"intact durable state. Stderr:\n{tail}\n"
                 )
                 return 1
             if rc == EX_USAGE:

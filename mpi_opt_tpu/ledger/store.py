@@ -530,9 +530,28 @@ class SweepLedger:
         return rec
 
     def _write_line(self, rec: dict) -> None:
-        self._file.write(json.dumps(rec) + "\n")
-        self._file.flush()
-        os.fsync(self._file.fileno())
+        from mpi_opt_tpu.utils import resources
+
+        try:
+            # chaos seam (inject_enospc): inside the append+fsync path
+            # so drills strike exactly where a real full disk would
+            resources.disk_fault("ledger_fsync", self.path)
+            self._file.write(json.dumps(rec) + "\n")
+            self._file.flush()
+            os.fsync(self._file.fileno())
+        except OSError as e:
+            if resources.is_storage_full(e):
+                # a full disk is an ANSWER, not a retryable blip: park
+                # with the classified type (CLI -> EX_IOERR=74). The
+                # append may have torn this line — the torn-tail
+                # self-heal already recovers exactly that shape on the
+                # post-free --resume
+                raise resources.StorageFull(
+                    "ledger journal append hit a full disk; free disk "
+                    "space and relaunch with --resume",
+                    path=self.path,
+                ) from e
+            raise
 
     # -- replay view -------------------------------------------------------
 

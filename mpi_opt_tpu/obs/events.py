@@ -43,6 +43,15 @@ EVENTS = frozenset(
         "snapshot_corrupt",
         "snapshot_io_retry",
         "snapshot_unverified",
+        # resource-exhaustion observer (utils/resources.py):
+        # oom_backoff = a device OOM absorbed by halving the wave and
+        # re-running the generation (bit-identical); wave_resized = a
+        # pre-launch headroom clamp of the wave size against the
+        # measured device budget; snapshot_pruned = the ENOSPC
+        # retention-prune retry deleted one superseded retained step
+        "oom_backoff",
+        "wave_resized",
+        "snapshot_pruned",
         # launch.py supervisor events
         "launch",
         "done",

@@ -16,7 +16,7 @@ import time
 
 from mpi_opt_tpu.service import tenants as tstates
 from mpi_opt_tpu.service.spool import ServerClaimError, Spool, SpoolError
-from mpi_opt_tpu.utils.exitcodes import EX_USAGE
+from mpi_opt_tpu.utils.exitcodes import EX_IOERR, EX_USAGE
 
 
 def _nonempty_dir(value: str) -> str:
@@ -173,6 +173,24 @@ def serve_main(argv) -> int:
         # other exception is a server crash and must keep its traceback
         print(str(e), file=sys.stderr)
         return EX_USAGE
+    except OSError as e:
+        from mpi_opt_tpu.utils.resources import is_storage_full
+
+        if not is_storage_full(e):
+            raise
+        # the SPOOL's disk filled (a tenant-status write, a queue
+        # admission): retry_io answered immediately instead of
+        # spinning, and the spool on disk IS the queue checkpoint —
+        # nothing is lost. Park the whole server with the classified
+        # code: free disk, restart, and every in-flight tenant resumes
+        # through the ordinary recovery (ISSUE 13).
+        print(
+            f"{e}\nspool disk full: server parked (exit {EX_IOERR}); "
+            "free disk space and restart `serve` — the spool state on "
+            "disk is the queue checkpoint, in-flight tenants resume",
+            file=sys.stderr,
+        )
+        return EX_IOERR
 
 
 def submit_main(argv) -> int:

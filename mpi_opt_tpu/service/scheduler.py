@@ -63,7 +63,7 @@ from mpi_opt_tpu.obs import memory as obs_memory
 from mpi_opt_tpu.service import leases, tenants as tstates
 from mpi_opt_tpu.service.programs import ProgramCache
 from mpi_opt_tpu.service.spool import Spool, TenantDir
-from mpi_opt_tpu.utils.exitcodes import EX_UNAVAILABLE
+from mpi_opt_tpu.utils.exitcodes import EX_UNAVAILABLE, classify
 
 
 def _read_summary(log_path: str, start: int) -> Optional[dict]:
@@ -90,6 +90,14 @@ def _read_summary(log_path: str, start: int) -> Optional[dict]:
 
 
 class SweepService:
+    #: how long a resource-exhaustion park (slice rc 74: disk full /
+    #: device OOM — utils/resources.py) keeps the tenant OUT of the
+    #: pick rotation. PARKED is deliberately non-terminal (freeing disk
+    #: + the ordinary --resume slice recovers), but re-picking it
+    #: immediately would spin the scheduler against a disk that is
+    #: still full; the cooldown turns the spin into a bounded re-probe.
+    IO_PARK_COOLDOWN_S = 60.0
+
     def __init__(
         self,
         state_dir: str,
@@ -355,6 +363,16 @@ class SweepService:
         for t in self._tenants():
             s = self._tenant_status(t)
             if s.get("state") in tstates.RUNNABLE:
+                # resource-park cooldown: a tenant parked on rc 74
+                # (disk full / device OOM) carries retry_after_ts —
+                # skip it until the clock passes, so the fleet probes
+                # the still-exhausted resource on a bounded cadence
+                # instead of spinning slices against it
+                try:
+                    if float(s.get("retry_after_ts") or 0.0) > time.time():
+                        continue
+                except (TypeError, ValueError):
+                    pass
                 candidates.append((t, s, None))
             else:
                 prior = self._takeover_candidate(t, s)
@@ -666,7 +684,22 @@ class SweepService:
         # unbounded array would make every slice end rewrite (and every
         # status call re-parse) O(total slices) on a long-lived server
         status["rc_history"] = ((status.get("rc_history") or []) + [rc])[-32:]
-        if state == tstates.PARKED and not delivered:
+        # resource-exhaustion park (rc 74): stamp the cooldown + reason
+        # so _pick_next holds the tenant out of rotation until the
+        # resource had a chance to be freed; any OTHER slice outcome
+        # clears the stamp (the resource answer is stale once a slice
+        # ran again)
+        if state == tstates.PARKED and classify(rc) == "io_error":
+            status["park_reason"] = "io_error"
+            status["retry_after_ts"] = round(
+                time.time() + self.IO_PARK_COOLDOWN_S, 4
+            )
+        else:
+            status.pop("park_reason", None)
+            status.pop("retry_after_ts", None)
+        if state == tstates.PARKED and not delivered and classify(rc) != "io_error":
+            # resource parks are not slice preemptions: the tenant did
+            # not drain at its budget, the RESOURCE refused the write
             status["preemptions"] = int(status.get("preemptions") or 0) + 1
         pc = status.setdefault("program_cache", {"hits": 0, "misses": 0})
         pc["hits" if cache_hit else "misses"] += 1

@@ -116,14 +116,21 @@ def retry_io(fn, attempts: int = 4, base_s: float = 0.02, sleep=time.sleep):
     (``FileNotFoundError``, ``FileExistsError``, permission refusals)
     raise immediately: they are answers the caller's protocol depends
     on, and "retrying" an O_EXCL loss would turn a lost race into a
-    4x-slower lost race. The last attempt's error propagates raw."""
+    4x-slower lost race. Storage exhaustion (ENOSPC/EDQUOT,
+    ``utils.resources.is_storage_full``) is ALSO an answer, not
+    weather: a full disk does not heal on a jittered backoff — spinning
+    on it only delays the diagnosis — so it raises immediately into the
+    resource-exhaustion classification (ISSUE 13). The last attempt's
+    error propagates raw."""
+    from mpi_opt_tpu.utils.resources import is_storage_full
+
     for i in range(attempts):
         try:
             return fn()
         except _NON_TRANSIENT_OS:
             raise
-        except OSError:
-            if i == attempts - 1:
+        except OSError as e:
+            if is_storage_full(e) or i == attempts - 1:
                 raise
             sleep(base_s * (2**i) * (0.5 + random.random()))
 

@@ -7,6 +7,9 @@ States::
     running ──rc 75 (SLICE)──────> parked      (runnable again)
     running ──rc 75 + cancel─────> cancelled
     running ──rc 75 (SIGTERM)────> parked      (server is draining)
+    running ──rc 74──────────────> parked      (resource exhausted:
+                                   state intact; re-picked only after a
+                                   cooldown so a full disk is not spun)
     running ──rc 65──────────────> data_error  (terminal, never retried)
     running ──rc 2───────────────> failed      (usage: deterministic)
     running ──rc other───────────> failed
@@ -54,6 +57,14 @@ def after_slice(rc: int, cancel_requested: bool) -> str:
     if outcome == "ok":
         return DONE
     if outcome == "preempted":
+        return CANCELLED if cancel_requested else PARKED
+    if outcome == "io_error":
+        # resource exhaustion (EX_IOERR=74, utils/resources.py): the
+        # tenant's durable state is INTACT — the failed write never
+        # landed and the newest verified step was never touched — so
+        # this is PARKED, not terminal-failed: freeing disk + the
+        # ordinary --resume slice recovers fsck-clean. The scheduler
+        # stamps a cooldown so a still-full disk is re-probed, not spun.
         return CANCELLED if cancel_requested else PARKED
     if outcome == "data_error":
         return DATA_ERROR

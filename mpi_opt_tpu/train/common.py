@@ -89,6 +89,21 @@ def momentum_dtype_str() -> str:
     return resolve_momentum_dtype() or "float32"
 
 
+def oom_funnel(wave_size=None):
+    """The fused drivers' device-OOM classification boundary (ISSUE 13):
+    wrap a launch dispatch so an XLA ``RESOURCE_EXHAUSTED`` escaping it
+    re-raises as ``utils.resources.DeviceOOM`` — the ONE type the CLI's
+    classified exit (``EX_IOERR``) and the wave scheduler's
+    ``--oom-backoff`` handler catch. All four fused drivers classify
+    through this door (run_fused wraps the whole dispatch; fused_pbt
+    additionally guards each wave so backoff can catch per-generation);
+    everything else propagates raw. ``wave_size`` rides on the typed
+    error so diagnostics can say what to halve."""
+    from mpi_opt_tpu.utils.resources import oom_funnel as _funnel
+
+    return _funnel(wave_size)
+
+
 def launch_boundary(stage: str, *, final: bool, snapshot=None, **progress) -> None:
     """The fused host loops' per-launch service point (one call at the
     end of every launch/rung/generation): write the rank heartbeat, then

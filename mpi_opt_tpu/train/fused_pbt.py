@@ -40,9 +40,10 @@ from mpi_opt_tpu.train.common import (
     launch_boundary,
     make_fused_journal,
     momentum_dtype_str,
+    oom_funnel,
     segment_flops_hint,
 )
-from mpi_opt_tpu.utils import profiling
+from mpi_opt_tpu.utils import profiling, resources
 from mpi_opt_tpu.train.population import OptHParams, PopState, PopulationTrainer
 
 
@@ -122,6 +123,33 @@ def _balanced_split(total: int, chunk: int) -> list[int]:
     n_parts = -(-total // chunk)
     base, rem = divmod(total, n_parts)
     return [base + 1] * rem + [base] * (n_parts - rem)
+
+
+def _wave_layout(population: int, wave_size: int):
+    """(wave_lens, offs, n_waves) for a wave cap — recomputed in place
+    when the OOM backoff halves the cap mid-run."""
+    wave_lens = _balanced_split(population, wave_size)
+    offs = [0]
+    for w in wave_lens[:-1]:
+        offs.append(offs[-1] + w)
+    return wave_lens, offs, len(wave_lens)
+
+
+def _engine_rollover(old):
+    """Fresh StagingEngine carrying the old one's cumulative accounting
+    (results and trace attrs report RUN totals): after a device OOM the
+    old engine may hold a latched transfer error — ``device_get`` of a
+    never-materialized wave fails on the worker thread — which would
+    refuse every later ``stage_out`` on sight."""
+    from mpi_opt_tpu.train.staging import StagingEngine
+
+    old.close()
+    new = StagingEngine()
+    new.staged_bytes = old.staged_bytes
+    new.transfers = old.transfers
+    new.transfer_s = old.transfer_s
+    new.wait_s = old.wait_s
+    return new
 
 
 @functools.partial(
@@ -233,6 +261,10 @@ def _run_wave(
     can intercept it, like ``run_fused_pbt``."""
     from mpi_opt_tpu.train.staging import stage_in, tree_bytes
 
+    # chaos seam (inject_oom): one guarded launch ordinal per wave —
+    # raises a synthetic RESOURCE_EXHAUSTED at the drilled wave, which
+    # the generation's oom_funnel classifies exactly like a real one
+    resources.launch_fault("wave")
     w = len(rows)
     if init_keys is not None:
         st = trainer.init_members(init_keys, sample_x)
@@ -296,8 +328,21 @@ def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gather
     snapshot_last: bool,
     ledger=None,
     warm_obs=None,
+    oom_backoff: int = 0,
 ):
     """Wave-scheduled fused PBT: ``population > residency``.
+
+    ``oom_backoff`` (ISSUE 13): on a device OOM during a generation's
+    wave launches, halve the wave cap and RE-RUN the generation from
+    its first wave, up to ``oom_backoff`` times — everything the re-run
+    needs (pool_front, unit, perm, the generation's carried key) is
+    still in host memory, reads of pool_front are non-destructive, and
+    wave mode is bit-identical at ANY wave size, so backoff preserves
+    result identity (tested). The settled-on cap is recorded in every
+    snapshot's meta (``wave_size_run``) and adopted on resume — once a
+    post-backoff snapshot lands, later resumes skip straight to the
+    settled cap (a crash in the backoff-to-snapshot window re-learns
+    the halving with a fresh budget; it converges, just not for free).
 
     Each generation trains ``ceil(P/W)`` resident waves of ~``W``
     members in sequence through the SAME compiled per-wave program
@@ -340,11 +385,13 @@ def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gather
     from mpi_opt_tpu.train.staging import StagingEngine, population_pool, write_rows
     from mpi_opt_tpu.utils.checkpoint import SweepCheckpointer
 
-    wave_lens = _balanced_split(population, wave_size)
-    n_waves = len(wave_lens)
-    offs = [0]
-    for w in wave_lens[:-1]:
-        offs.append(offs[-1] + w)
+    # the REQUESTED cap is the sweep's config identity (stable across
+    # resumes under the same flag); the EXECUTION cap below may shrink
+    # via OOM backoff, recorded per snapshot in meta (wave_size_run)
+    req_wave_size = wave_size
+    wave_lens, offs, n_waves = _wave_layout(population, wave_size)
+    oom_budget = max(0, int(oom_backoff))
+    n_backoffs = 0
     disc = tuple(bool(b) for b in space.discrete_mask())
     hparams_fn = HParamsFn(space, workload)
     key = jax.random.key(seed)
@@ -384,8 +431,12 @@ def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gather
                 "momentum_dtype": momentum_dtype_str(),
                 # the wave split is part of the sweep's identity: the
                 # snapshot payload is pool+perm shaped by it, and a
-                # resident run must not silently resume a wave snapshot
-                "wave_size": wave_size,
+                # resident run must not silently resume a wave snapshot.
+                # The REQUESTED cap, deliberately: an OOM backoff's
+                # smaller execution cap lives in meta (wave_size_run),
+                # so a resume under the same flag matches here and
+                # adopts the settled cap below
+                "wave_size": req_wave_size,
                 "wave_lens": list(wave_lens),
             },
         )
@@ -398,6 +449,14 @@ def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gather
             gen_walls = [float(v) for v in meta["gen_walls"]]
             start_gen = int(meta["gen"])
             start_wave = int(meta["waves_done"])
+            # adopt a prior attempt's OOM-settled cap: waves_done counts
+            # waves of the split the snapshot was taken under, and
+            # resuming at the requested size would re-OOM a generation
+            # just to re-learn the answer
+            run_ws = int(meta.get("wave_size_run", wave_size))
+            if run_ws != wave_size:
+                wave_size = run_ws
+                wave_lens, offs, n_waves = _wave_layout(population, wave_size)
             pool_front = _writable(sweep["front"])
             perm = np.asarray(sweep["perm"])
             unit = jnp.asarray(sweep["unit"])
@@ -462,125 +521,171 @@ def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gather
             # the carried-key chain matches run_fused_pbt.one_generation
             # exactly: next carry, train key, exploit key
             k_run, k_train, k_pbt = jax.random.split(k_gen, 3)
-            wave_scores: list = [None] * n_waves
-            w0 = 0
-            if resumed_mid:
-                w0 = start_wave
-                for w in range(start_wave):
-                    off, wl_ = offs[w], wave_lens[w]
-                    # completed waves' scores round-trip exactly (f32)
-                    wave_scores[w] = jnp.asarray(scores_host[off : off + wl_])
-            def _train_generation():
-                for w in range(w0, n_waves):
-                    off, wl_ = offs[w], wave_lens[w]
-                    st, sc = _run_wave(
-                        trainer,
-                        pool_front,
-                        perm[off : off + wl_],
-                        off,
-                        unit,
-                        hparams_fn,
-                        train_x,
-                        train_y,
-                        val_x,
-                        val_y,
-                        k_train,
-                        steps_per_gen,
-                        population,
-                        mesh,
-                        engine,
-                        init_keys=member_keys[off : off + wl_] if g == 0 else None,
-                        sample_x=train_x[:2],
-                    )
-                    wave_scores[w] = sc
-                    # per-wave liveness (ROADMAP follow-up): beat as soon as
-                    # the wave's programs are dispatched, so a stall timeout
-                    # sized to one wave also covers the generation's LAST
-                    # wave (whose next boundary beat waits on the full drain
-                    # + exploit)
-                    from mpi_opt_tpu.health import heartbeat
+            while True:  # one iteration per OOM-backoff attempt
+                wave_scores: list = [None] * n_waves
+                w0 = 0
+                if resumed_mid:
+                    w0 = start_wave
+                    for w in range(start_wave):
+                        off, wl_ = offs[w], wave_lens[w]
+                        # completed waves' scores round-trip exactly (f32)
+                        wave_scores[w] = jnp.asarray(scores_host[off : off + wl_])
+                def _train_generation(w0=w0, wave_scores=wave_scores):
+                    for w in range(w0, n_waves):
+                        off, wl_ = offs[w], wave_lens[w]
+                        st, sc = _run_wave(
+                            trainer,
+                            pool_front,
+                            perm[off : off + wl_],
+                            off,
+                            unit,
+                            hparams_fn,
+                            train_x,
+                            train_y,
+                            val_x,
+                            val_y,
+                            k_train,
+                            steps_per_gen,
+                            population,
+                            mesh,
+                            engine,
+                            init_keys=member_keys[off : off + wl_] if g == 0 else None,
+                            sample_x=train_x[:2],
+                        )
+                        wave_scores[w] = sc
+                        # per-wave liveness (ROADMAP follow-up): beat as soon as
+                        # the wave's programs are dispatched, so a stall timeout
+                        # sized to one wave also covers the generation's LAST
+                        # wave (whose next boundary beat waits on the full drain
+                        # + exploit)
+                        from mpi_opt_tpu.health import heartbeat
 
-                    heartbeat.beat(
-                        stage=f"pbt gen {g + 1}/{generations} wave "
-                        f"{w + 1}/{n_waves} dispatched"
-                    )
-                    # async stage-out: the background fetch blocks on THIS
-                    # wave's compute while the loop dispatches the next wave
-                    engine.stage_out(
-                        {
-                            "state": {
-                                "params": st.params,
-                                "momentum": st.momentum,
-                                "step": st.step,
+                        heartbeat.beat(
+                            stage=f"pbt gen {g + 1}/{generations} wave "
+                            f"{w + 1}/{n_waves} dispatched"
+                        )
+                        # async stage-out: the background fetch blocks on THIS
+                        # wave's compute while the loop dispatches the next wave
+                        engine.stage_out(
+                            {
+                                "state": {
+                                    "params": st.params,
+                                    "momentum": st.momentum,
+                                    "step": st.step,
+                                },
+                                "scores": sc,
                             },
-                            "scores": sc,
-                        },
-                        _writer(off),
-                    )
-
-                    def save_midgen(g=g, w=w):  # sweeplint: barrier(between-waves drain snapshot: fetches partial state for the checkpoint)
-                        engine.drain()  # pools must hold every completed wave
-                        # COPY the pools: orbax's save is async, and the live
-                        # buffers are mutated in place by later waves' stage-out
-                        # writers — handing them over uncopied can tear the
-                        # snapshot (same contract as the resident path's
-                        # host-fetch-before-save)
-                        snap.save(
-                            g * n_waves + w + 1,
-                            sweep={
-                                "front": jax.tree.map(np.array, pool_front),
-                                "back": jax.tree.map(np.array, pool_back),
-                                "perm": np.asarray(perm),
-                                "unit": fetch_global(unit),
-                                "key_data": np.asarray(jax.random.key_data(k_gen)),
-                                "scores": scores_host.copy(),
-                            },
-                            meta_extra={
-                                "gen": g,
-                                "waves_done": w + 1,
-                                # a mid-generation snapshot completes no
-                                # boundary: only g generations are journaled
-                                "boundaries_done": g,
-                                "best": best_list,
-                                "mean": mean_list,
-                                "member_fail": fail_list,
-                                "gen_walls": gen_walls,
-                                "wall_partial": time.perf_counter() - t_gen + gen_partial0,
-                            },
+                            _writer(off),
                         )
 
-                    if w + 1 < n_waves:
-                        # between-waves service point: heartbeat + graceful
-                        # drain with a mid-generation snapshot (completed
-                        # waves are never re-trained on resume)
-                        launch_boundary(
-                            f"pbt gen {g + 1}/{generations} wave {w + 1}/{n_waves}",
-                            final=False,
-                            snapshot=None if snap is None else save_midgen,
-                            launch=g * n_waves + w + 1,
-                            of=generations * n_waves,
-                        )
-                # generation boundary: the ONLY hard transfer barrier —
-                # exploit needs the full score vector and a settled pool
-                engine.drain()
+                        def save_midgen(g=g, w=w):  # sweeplint: barrier(between-waves drain snapshot: fetches partial state for the checkpoint)
+                            engine.drain()  # pools must hold every completed wave
+                            # COPY the pools: orbax's save is async, and the live
+                            # buffers are mutated in place by later waves' stage-out
+                            # writers — handing them over uncopied can tear the
+                            # snapshot (same contract as the resident path's
+                            # host-fetch-before-save)
+                            snap.save(
+                                g * n_waves + w + 1,
+                                sweep={
+                                    "front": jax.tree.map(np.array, pool_front),
+                                    "back": jax.tree.map(np.array, pool_back),
+                                    "perm": np.asarray(perm),
+                                    "unit": fetch_global(unit),
+                                    "key_data": np.asarray(jax.random.key_data(k_gen)),
+                                    "scores": scores_host.copy(),
+                                },
+                                meta_extra={
+                                    "gen": g,
+                                    "waves_done": w + 1,
+                                    # a mid-generation snapshot completes no
+                                    # boundary: only g generations are journaled
+                                    "boundaries_done": g,
+                                    # the OOM-settled execution cap: waves_done
+                                    # counts waves of THIS split, and a resume
+                                    # must adopt it rather than re-OOM
+                                    "wave_size_run": wave_size,
+                                    "best": best_list,
+                                    "mean": mean_list,
+                                    "member_fail": fail_list,
+                                    "gen_walls": gen_walls,
+                                    "wall_partial": time.perf_counter() - t_gen + gen_partial0,
+                                },
+                            )
 
-            # the generation's train span covers every wave dispatch AND
-            # the drain barrier, so its duration is the generation's real
-            # compute+transfer wall; nested stage_in/stage_out/stage_wait/
-            # save spans subtract from its self time. ``flops`` makes the
-            # trace CLI report achieved TF/s per generation.
-            profiling.launch_tick()
-            with trace.span("train", launch=g + 1, gens=1, waves=n_waves) as sp:
-                _train_generation()
-                # flops only AFTER the drain barrier completed: a
-                # generation interrupted between waves emits its real
-                # partial duration WITHOUT the attr, so the trace CLI
-                # never divides full-generation FLOPs by partial wall
-                if flops_gen:
-                    sp["flops"] = flops_gen
-                # post-drain device-memory watermark: the generation's
-                # peak residency (two waves + activations) just happened
-                memory.note(sp)
+                        if w + 1 < n_waves:
+                            # between-waves service point: heartbeat + graceful
+                            # drain with a mid-generation snapshot (completed
+                            # waves are never re-trained on resume)
+                            launch_boundary(
+                                f"pbt gen {g + 1}/{generations} wave {w + 1}/{n_waves}",
+                                final=False,
+                                snapshot=None if snap is None else save_midgen,
+                                launch=g * n_waves + w + 1,
+                                of=generations * n_waves,
+                            )
+                    # generation boundary: the ONLY hard transfer barrier —
+                    # exploit needs the full score vector and a settled pool
+                    engine.drain()
+
+                # the generation's train span covers every wave dispatch AND
+                # the drain barrier, so its duration is the generation's real
+                # compute+transfer wall; nested stage_in/stage_out/stage_wait/
+                # save spans subtract from its self time. ``flops`` makes the
+                # trace CLI report achieved TF/s per generation. The
+                # oom_funnel classifies an XLA RESOURCE_EXHAUSTED escaping
+                # any wave into typed DeviceOOM for the backoff below.
+                profiling.launch_tick()
+                try:
+                    with oom_funnel(wave_size):
+                        with trace.span(
+                            "train", launch=g + 1, gens=1, waves=n_waves
+                        ) as sp:
+                            _train_generation()
+                            # flops only AFTER the drain barrier completed: a
+                            # generation interrupted between waves emits its real
+                            # partial duration WITHOUT the attr, so the trace CLI
+                            # never divides full-generation FLOPs by partial wall
+                            if flops_gen:
+                                sp["flops"] = flops_gen
+                            # post-drain device-memory watermark: the generation's
+                            # peak residency (two waves + activations) just happened
+                            memory.note(sp)
+                    break
+                except resources.DeviceOOM as e:
+                    if oom_budget <= 0 or wave_size <= 1:
+                        # no wave left to halve (or backoff disabled):
+                        # the classified answer propagates — CLI exit 74
+                        raise
+                    oom_budget -= 1
+                    n_backoffs += 1
+                    # settle what completed; a transfer that died WITH
+                    # the OOM latched its error in the engine — roll it
+                    # over (accounting carried) so re-run stage-outs
+                    # aren't refused on sight
+                    try:
+                        engine.drain()
+                    # sweeplint: disable=drain-swallow -- settling in-flight transfers before the backoff re-run: the error here is the same already-classified OOM this handler is absorbing, and the engine is rolled over fresh below
+                    except BaseException:
+                        pass
+                    engine = _engine_rollover(engine)
+                    wave_size = max(1, wave_size // 2)
+                    wave_lens, offs, n_waves = _wave_layout(population, wave_size)
+                    # re-run THIS generation from wave 0 under the new
+                    # split: pool_front reads are non-destructive, the
+                    # generation's keys (k_train/k_pbt) are already
+                    # derived, and rewritten pool_back rows carry
+                    # identical values — bit-identity is preserved
+                    scores_host[:] = np.nan
+                    resumed_mid = False
+                    resources.notify(
+                        "oom_backoff",
+                        gen=g + 1,
+                        wave_size=wave_size,
+                        remaining=oom_budget,
+                        error=str(e)[:300],
+                    )
+                    continue
             # journal this generation's members (pre-exploit scores +
             # the units they trained with) BEFORE the boundary snapshot;
             # a resumed generation verifies instead of re-writing
@@ -629,6 +734,8 @@ def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gather
                         "gen": g + 1,
                         "waves_done": 0,
                         "boundaries_done": g + 1,
+                        # the OOM-settled execution cap (adopted on resume)
+                        "wave_size_run": wave_size,
                         "best": best_list,
                         "mean": mean_list,
                         "member_fail": fail_list,
@@ -674,10 +781,15 @@ def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gather
         "launch_walls": [float(v) for v in gen_walls],
         # wave-scheduling observability (acceptance: staging must be
         # visible, not inferred): bytes moved and how much of the
-        # transfer time the double buffer hid behind compute
+        # transfer time the double buffer hid behind compute.
+        # wave_size/wave_lens are the EXECUTION split — after an OOM
+        # backoff they differ from the requested cap, which is the point
         "wave_size": wave_size,
         "wave_lens": list(wave_lens),
         "n_waves": n_waves,
+        # device-OOM halvings absorbed this run (ISSUE 13): each one
+        # re-ran its generation at half the wave, bit-identically
+        "oom_backoffs": n_backoffs,
         "staged_bytes": int(engine.staged_bytes),
         "stage_transfer_s": float(engine.transfer_s),
         "stage_wait_s": float(engine.wait_s),
@@ -755,9 +867,19 @@ def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, expl
     snapshot_last: bool = True,
     ledger=None,
     warm_obs=None,
+    oom_backoff: int = 2,
 ):
     """Convenience wrapper: run a whole PBT sweep for a vision-style
     workload; optionally sharded over a ``('pop','data')`` mesh.
+
+    ``oom_backoff`` (wave mode; ISSUE 13): budget of automatic
+    wave-size halvings on a device OOM — each absorbed OOM re-runs its
+    generation at half the wave, bit-identically (0 disables; resident
+    mode and an exhausted budget raise typed ``DeviceOOM``, which the
+    CLI maps to the classified exit 74). With a MEASURED device budget
+    (obs/memory.py) an explicit cap above the residency estimate is
+    also pre-clamped before the first launch (``wave_resized``), so the
+    common case never OOMs at all.
 
     ``ledger`` (an open ``SweepLedger`` whose fused header the CLI has
     already committed) journals one record per member per generation —
@@ -841,11 +963,43 @@ def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, expl
     if wave_size:
         from mpi_opt_tpu.train.staging import estimate_wave_size
 
-        if wave_size == "auto":
+        was_auto = wave_size == "auto"
+        if was_auto:
             wave_size = estimate_wave_size(trainer, train_x[:2], population, mesh)
+            if wave_size < population:
+                # the pre-launch headroom clamp engaged: auto sized the
+                # wave from the measured budget (or its fallbacks)
+                # BEFORE the first OOM — record it as an event, not a
+                # silent number (ISSUE 13)
+                resources.notify(
+                    "wave_resized",
+                    requested="auto",
+                    wave_size=int(wave_size),
+                    population=population,
+                )
         wave_size = int(wave_size)
         if wave_size < 0:
             raise ValueError(f"wave_size must be >= 0, got {wave_size}")
+        if oom_backoff and not was_auto and 0 < wave_size < population:
+            from mpi_opt_tpu.obs import memory as obs_memory
+
+            # EXPLICIT cap vs MEASURED headroom (auto already sized
+            # from the estimate — re-deriving it here would compare the
+            # estimate against itself for a wasted eval_shape pass; and
+            # never clamp against the 8 GiB default — shrinking a
+            # hand-picked cap on a guess would surprise, the measured
+            # bytes_limit is evidence): shrink before the first OOM
+            # instead of paying one
+            if obs_memory.measured_budget() is not None:
+                est = estimate_wave_size(trainer, train_x[:2], population, mesh)
+                if est < wave_size:
+                    resources.notify(
+                        "wave_resized",
+                        requested=wave_size,
+                        wave_size=est,
+                        population=population,
+                    )
+                    wave_size = est
         if 0 < wave_size < population:
             if step_chunk > 0 or gen_chunk > 1:
                 raise ValueError(
@@ -880,6 +1034,7 @@ def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, expl
                 snapshot_last,
                 ledger,
                 warm_obs,
+                oom_backoff=oom_backoff,
             )
     key = jax.random.key(seed)
     k_init, k_unit, k_run = jax.random.split(key, 3)
@@ -1007,8 +1162,18 @@ def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, expl
             t_launch = time.perf_counter()
             # the launch's train span covers dispatch AND the curve
             # fetches (the launch completion barrier), so dur_s is the
-            # launch's real wall and flops/dur_s is achieved TF/s
-            with trace.span("train", launch=i + 1, gens=launch_lens[i]) as _sp:
+            # launch's real wall and flops/dur_s is achieved TF/s.
+            # Resident mode has no wave to halve: the funnel's DeviceOOM
+            # propagates to the CLI's classified exit (74) instead of an
+            # unclassified traceback launch.py would burn retries on
+            with oom_funnel(), trace.span(
+                "train", launch=i + 1, gens=launch_lens[i]
+            ) as _sp:
+                # chaos seam (inject_oom): one guarded launch ordinal; a
+                # synthetic RESOURCE_EXHAUSTED here classifies exactly
+                # like a real warmup OOM (the staging.py docstring's
+                # pop=1024 death shape) — typed via the funnel above
+                resources.launch_fault("launch")
                 if step_chunk > 0:
                     # one generation as k sub-segment launches + a boundary
                     # launch; the carried key advances exactly once per gen

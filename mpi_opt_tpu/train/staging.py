@@ -273,12 +273,94 @@ class StagingEngine:
         self.close()
 
 
+def _per_member_bytes(trainer, sample_x) -> int:
+    """The static per-member envelope: params at their own dtypes plus
+    momentum at the trainer's storage dtype, from ``jax.eval_shape``
+    over the trainer's init (abstract — no compute, no allocation).
+    ONE home for the byte math ``estimate_wave_size`` sizes with and
+    ``envelope_report`` validates against measurement."""
+    params_sd = jax.eval_shape(trainer.init_fn, jax.random.key(0), sample_x)
+    p_bytes = tree_bytes(params_sd)
+    m_dt = trainer.momentum_dtype
+    if m_dt is None:
+        return 2 * p_bytes
+    itemsize = np.dtype(m_dt).itemsize
+    return p_bytes + sum(
+        int(np.prod(l.shape)) * itemsize for l in jax.tree.leaves(params_sd)
+    )
+
+
+def measured_train_peak(metrics_path: str) -> Optional[int]:
+    """The max ``mem_peak_bytes`` watermark over the device-occupying
+    spans (train / stage_in / stage_out) of a prior traced run's JSONL
+    metrics stream (ISSUE 10 instrumented them; ISSUE 13 closes the
+    loop by reading them back). None when the stream has no usable
+    watermark — untraced run, missing file, or pre-watermark records.
+    Torn/foreign lines are skipped, not fatal: a metrics stream is
+    append-only and may end mid-line after a kill."""
+    import json
+
+    peak = None
+    try:
+        with open(metrics_path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict) or rec.get("event") != "span":
+                    continue
+                if rec.get("span") not in ("train", "stage_in", "stage_out"):
+                    continue
+                v = rec.get("mem_peak_bytes")
+                if isinstance(v, (int, float)):
+                    peak = max(peak or 0, int(v))
+    except OSError:
+        return None
+    return peak
+
+
+def envelope_report(trainer, sample_x, population: int, metrics_path: str) -> dict:
+    """Validate the static per-member envelope math against a MEASURED
+    watermark (the carried ROADMAP item: "validate the 4.5 GB pop=1024
+    envelope math against measured mem_peak_bytes watermarks").
+
+    ``metrics_path`` is a prior traced run of the SAME (workload,
+    population) — its train-span ``mem_peak_bytes`` is what the
+    population actually cost the device (allocator counters on TPU;
+    live-array accounting on CPU, which also sees datasets — the
+    ``measured_over_static`` ratio is therefore a CEILING of the true
+    state overhead there, honest but conservative). Returns::
+
+        {"per_member_bytes", "static_pop_bytes", "measured_peak_bytes",
+         "measured_over_static"}
+
+    with None measurement fields when the stream carries no watermark.
+    The static math is validated (not replaced): a ratio far above the
+    activation-headroom assumption baked into ``estimate_wave_size``'s
+    35% offer means the envelope UNDERestimates and auto waves would
+    OOM — feed the measurement back via that function's
+    ``measured_peak`` argument."""
+    per_member = _per_member_bytes(trainer, sample_x)
+    static_pop = per_member * int(population)
+    peak = measured_train_peak(metrics_path)
+    return {
+        "per_member_bytes": int(per_member),
+        "static_pop_bytes": int(static_pop),
+        "measured_peak_bytes": None if peak is None else int(peak),
+        "measured_over_static": (
+            None if peak is None or static_pop <= 0 else round(peak / static_pop, 4)
+        ),
+    }
+
+
 def estimate_wave_size(
     trainer,
     sample_x,
     population: int,
     mesh=None,
     budget_bytes: Optional[int] = None,
+    measured_peak: Optional[tuple] = None,
 ) -> int:
     """Residency estimate for ``--wave-size auto``: the largest wave the
     device budget fits with double-buffer + activation headroom.
@@ -298,23 +380,24 @@ def estimate_wave_size(
     training needs activation/update headroom on top (the measured
     envelope: 4.5 GB of state tipped a 16 GB chip — PERF_NOTES).
 
+    ``measured_peak`` (ISSUE 13, closing the ROADMAP envelope-math
+    item): ``(peak_bytes, resident_members)`` from a prior traced run —
+    typically ``measured_train_peak(stream)`` with the members that run
+    held resident. The measured all-in per-member cost (state +
+    activations + double buffer, everything the allocator actually saw)
+    sizes a second wave estimate WITHOUT the 35% static headroom guess
+    (the measurement already includes what the guess models, modulo a
+    15% safety margin), and the SMALLER of the two estimates wins —
+    measurement tightens the static math, never loosens it past what
+    the envelope would allow.
+
     With a mesh the wave shards over the 'pop' axis, so the budget
     scales by that axis and the result is rounded DOWN to a multiple of
     it (replicated waves would defeat the mesh silently). Returns a
     value in [1, population]; ``population`` means everything fits —
     callers run resident mode.
     """
-    params_sd = jax.eval_shape(trainer.init_fn, jax.random.key(0), sample_x)
-    p_bytes = tree_bytes(params_sd)
-    m_dt = trainer.momentum_dtype
-    if m_dt is None:
-        m_bytes = p_bytes
-    else:
-        itemsize = np.dtype(m_dt).itemsize
-        m_bytes = sum(
-            int(np.prod(l.shape)) * itemsize for l in jax.tree.leaves(params_sd)
-        )
-    per_member = p_bytes + m_bytes
+    per_member = _per_member_bytes(trainer, sample_x)
     if budget_bytes is None:
         env = os.environ.get("MPI_OPT_TPU_DEVICE_BYTES")
         if env:
@@ -327,6 +410,15 @@ def estimate_wave_size(
         budget_bytes = 8 << 30
     n_pop = int(mesh.shape["pop"]) if mesh is not None else 1
     w = int(budget_bytes * 0.35 * n_pop // max(1, per_member))
+    if measured_peak:
+        peak_bytes, members = measured_peak
+        if peak_bytes and members:
+            # all-in measured cost per member: no 0.35 headroom guess
+            # (the watermark already holds activations + buffers), just
+            # a 15% safety margin against run-to-run spread
+            measured_member = max(1, int(peak_bytes) // max(1, int(members)))
+            w_measured = int(budget_bytes * 0.85 * n_pop // measured_member)
+            w = min(w, max(1, w_measured))
     if mesh is not None and w > n_pop:
         w -= w % n_pop
     return max(1, min(population, w))

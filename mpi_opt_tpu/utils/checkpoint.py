@@ -38,7 +38,95 @@ from typing import Any, Optional
 import orbax.checkpoint as ocp
 
 from mpi_opt_tpu.obs import memory, trace
-from mpi_opt_tpu.utils import integrity
+from mpi_opt_tpu.utils import integrity, resources
+
+
+def _prune_superseded(mgr, directory: str) -> Optional[int]:
+    """Delete the OLDEST retained step (the retention-prune half of the
+    ENOSPC recovery): a superseded verified step is exactly the bytes
+    retention policy was already going to discard — reclaiming it to
+    land the CURRENT save trades fallback depth for forward progress.
+    The newest step is NEVER touched (it is the resume point a parked
+    run recovers through); with fewer than two steps there is nothing
+    prunable and the caller parks instead. Returns the pruned step."""
+    import shutil
+
+    steps = sorted(mgr.all_steps())
+    if len(steps) < 2:
+        return None
+    victim = int(steps[0])
+    shutil.rmtree(os.path.join(directory, str(victim)), ignore_errors=True)
+    mgr.reload()  # forget the deleted step
+    return victim
+
+
+def _wait_classified(mgr, directory: str) -> None:
+    """Drain pending async saves with the storage classification: orbax
+    saves are asynchronous, so a REAL disk-full often surfaces not at
+    the enqueue (_save_storage_guard's territory) but in the background
+    writer — re-raised here at close()'s ``wait_until_finished``. An
+    unclassified ENOSPC escaping close() would exit as a generic rc 1
+    traceback and launch.py would burn its whole retry budget on it —
+    the exact failure mode the classifier exists to end. The failed
+    write never committed its step, so durable state is the last
+    committed step and the free-disk + --resume recovery holds."""
+    try:
+        mgr.wait_until_finished()
+    except Exception as e:
+        if not resources.is_storage_full(e):
+            raise
+        raise resources.StorageFull(
+            "async snapshot write hit a full disk; durable state is the "
+            "last committed step — free disk space and relaunch with "
+            "--resume",
+            path=directory,
+        ) from e
+
+
+def _save_storage_guard(mgr, directory: str, enqueue) -> None:
+    """Run ``enqueue()`` (the orbax save) with the storage-exhaustion
+    lifecycle (ISSUE 13): a classified ENOSPC/EDQUOT gets ONE
+    retention-prune retry — delete the oldest superseded retained step,
+    never the newest — then parks by raising typed ``StorageFull`` (the
+    CLI maps it to ``EX_IOERR``=74, which launch.py treats as
+    non-retryable-with-diagnostics and the service as parked). The
+    chaos seam (``resources.disk_fault``) sits INSIDE each attempt so
+    ``inject_enospc`` schedules are re-consulted on the retry, exactly
+    like the spool injector. Non-storage failures propagate raw."""
+
+    def attempt():
+        resources.disk_fault("snapshot_save", directory)
+        enqueue()
+
+    try:
+        attempt()
+        return
+    except Exception as e:
+        if not resources.is_storage_full(e):
+            raise
+        first = e
+    victim = _prune_superseded(mgr, directory)
+    if victim is None:
+        # nothing prunable without touching the newest verified step:
+        # park now, state intact (the failed save never landed)
+        raise resources.StorageFull(
+            "snapshot save hit a full disk and no superseded retained "
+            "step remains to prune (the newest verified step is never "
+            "touched); free disk space and relaunch with --resume",
+            path=directory,
+        ) from first
+    resources.notify("snapshot_pruned", step=victim, directory=directory)
+    try:
+        attempt()
+    except Exception as e:
+        if not resources.is_storage_full(e):
+            raise
+        raise resources.StorageFull(
+            "snapshot save still hit a full disk after pruning one "
+            f"superseded step ({victim}); free disk space and relaunch "
+            "with --resume",
+            path=directory,
+        ) from e
 
 
 def _step_item_names(mgr, directory: str, step: int) -> set:
@@ -166,7 +254,11 @@ class SearchCheckpointer:
             # of restore being able to prove the bytes survived)
             manifest = integrity.build_manifest({"search": search}, tree_items)
             items[integrity.MANIFEST_ITEM] = ocp.args.JsonSave(manifest)
-            self._mgr.save(step, args=ocp.args.Composite(**items))
+            _save_storage_guard(
+                self._mgr,
+                self.directory,
+                lambda: self._mgr.save(step, args=ocp.args.Composite(**items)),
+            )
 
     # -- restore -----------------------------------------------------------
 
@@ -237,9 +329,10 @@ class SearchCheckpointer:
 
     def close(self) -> None:
         # save_wait: where the async saves' background write time
-        # surfaces on the host (the drain before the manager closes)
+        # surfaces on the host (the drain before the manager closes) —
+        # and where a background writer's ENOSPC re-raises, classified
         with trace.span("save_wait"):
-            self._mgr.wait_until_finished()
+            _wait_classified(self._mgr, self.directory)
         self._mgr.close()
 
     def __enter__(self):
@@ -280,12 +373,16 @@ class SweepCheckpointer:
             # (sweep arrays are host-fetched by every caller, so digesting
             # costs hashing only, no extra device fetch)
             manifest = integrity.build_manifest({"meta": meta}, {"sweep": sweep})
-            self._mgr.save(
-                step,
-                args=ocp.args.Composite(
-                    sweep=ocp.args.StandardSave(sweep),
-                    meta=ocp.args.JsonSave(meta),
-                    **{integrity.MANIFEST_ITEM: ocp.args.JsonSave(manifest)},
+            _save_storage_guard(
+                self._mgr,
+                self.directory,
+                lambda: self._mgr.save(
+                    step,
+                    args=ocp.args.Composite(
+                        sweep=ocp.args.StandardSave(sweep),
+                        meta=ocp.args.JsonSave(meta),
+                        **{integrity.MANIFEST_ITEM: ocp.args.JsonSave(manifest)},
+                    ),
                 ),
             )
 
@@ -371,7 +468,7 @@ class SweepCheckpointer:
 
     def close(self) -> None:
         with trace.span("save_wait"):
-            self._mgr.wait_until_finished()
+            _wait_classified(self._mgr, self.directory)
         self._mgr.close()
 
 

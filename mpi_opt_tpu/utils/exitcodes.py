@@ -30,6 +30,14 @@ The codes (sysexits.h where one exists):
   rather than fight over the spool. The work is fine; this process's
   claim to it is not. A supervisor may restart it under a fresh id;
   retrying the same identity re-refuses while the usurper lives.
+- ``EX_IOERR`` (74): resource exhaustion as a classified ANSWER
+  (utils/resources.py) — the disk filled mid-snapshot/journal after
+  the one retention-prune retry, or the device OOM'd with no wave left
+  to halve. Durable state is INTACT (unlike 65: the failed write never
+  landed, the newest verified step was never touched) but retrying
+  changes nothing until an operator frees the resource — launch.py
+  aborts with diagnostics, budget untouched; the service PARKS the
+  tenant (not terminal) so freeing disk + ``--resume`` recovers.
 """
 
 from __future__ import annotations
@@ -42,6 +50,9 @@ EX_DATAERR = 65
 # sysexits.h EX_UNAVAILABLE: "service unavailable" — the fenced-zombie
 # step-down (fleet federation; see service/leases.py)
 EX_UNAVAILABLE = 69
+# sysexits.h EX_IOERR: "an error occurred while doing I/O" — the
+# resource-exhaustion park (device OOM / disk full; utils/resources.py)
+EX_IOERR = 74
 # sysexits.h EX_TEMPFAIL: "temporary failure, user is invited to retry"
 EX_TEMPFAIL = 75
 
@@ -50,16 +61,20 @@ _OUTCOMES = {
     EX_USAGE: "usage",
     EX_DATAERR: "data_error",
     EX_UNAVAILABLE: "unavailable",
+    EX_IOERR: "io_error",
     EX_TEMPFAIL: "preempted",
 }
 
 
 def classify(rc: int) -> str:
     """Exit code -> outcome class: ``ok`` / ``usage`` / ``data_error``
-    / ``unavailable`` / ``preempted`` / ``failure`` (the catch-all for
-    every other nonzero code, including 1). ``preempted`` is the only
-    outcome that means "resumable, for free"; ``usage`` and
+    / ``unavailable`` / ``io_error`` / ``preempted`` / ``failure`` (the
+    catch-all for every other nonzero code, including 1). ``preempted``
+    is the only outcome that means "resumable, for free"; ``usage`` and
     ``data_error`` are terminal-without-retry; ``unavailable`` is the
     fleet's step-down (the PROCESS lost its identity, the work did
-    not); ``failure`` is terminal-or-retry at the caller's budget."""
+    not); ``io_error`` is resumable-after-operator-action (state is
+    intact, the RESOURCE is exhausted — a retry without freeing it
+    re-fails identically, so supervisors abort but services only
+    park); ``failure`` is terminal-or-retry at the caller's budget."""
     return _OUTCOMES.get(int(rc), "failure")

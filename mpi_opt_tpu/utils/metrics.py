@@ -78,6 +78,16 @@ class MetricsLogger:
         # this server claimed from a dead/expired peer's lease and
         # resumed — the observable form of "a dead host strands nothing"
         self.takeovers = 0
+        # resource-exhaustion counters (utils/resources.py):
+        # oom_backoffs = device-OOM wave halvings the fused scheduler
+        # absorbed (each one re-ran a generation at half the wave and
+        # kept the result bit-identical); wave_resized = pre-launch
+        # headroom clamps of --wave-size against the measured budget;
+        # snapshots_pruned = superseded retained steps deleted by the
+        # ENOSPC retention-prune retry (never the newest verified step)
+        self.oom_backoffs = 0
+        self.wave_resized = 0
+        self.snapshots_pruned = 0
 
     def log(self, event: str, **fields) -> dict:
         # `t` is relative (this process's clock, for intra-run deltas);
@@ -158,6 +168,18 @@ class MetricsLogger:
         """Expired-lease tenant takeovers this server performed."""
         self.takeovers += int(n)
 
+    def count_oom_backoffs(self, n: int = 1):
+        """Device-OOM wave halvings absorbed by the fused scheduler."""
+        self.oom_backoffs += int(n)
+
+    def count_wave_resized(self, n: int = 1):
+        """Pre-launch wave-size headroom clamps (estimate vs budget)."""
+        self.wave_resized += int(n)
+
+    def count_pruned(self, n: int = 1):
+        """Superseded snapshot steps pruned by the ENOSPC retry."""
+        self.snapshots_pruned += int(n)
+
     @property
     def wall(self) -> float:
         return time.perf_counter() - self.t_start
@@ -185,6 +207,9 @@ class MetricsLogger:
             program_cache_hits=self.program_cache_hits,
             program_cache_misses=self.program_cache_misses,
             takeovers=self.takeovers,
+            oom_backoffs=self.oom_backoffs,
+            wave_resized=self.wave_resized,
+            snapshots_pruned=self.snapshots_pruned,
             wall_s=round(self.wall, 3),
             trials_per_sec_per_chip=round(self.trials_per_sec_per_chip(), 4),
             **extra,

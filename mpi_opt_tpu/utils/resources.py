@@ -1,0 +1,213 @@
+"""Resource-exhaustion classification: ONE funnel for the two failure
+classes that scale-out guarantees — device OOM and storage exhaustion.
+
+The blind spot this closes (ISSUE 13): `train/staging.py`'s own
+docstring names the device wall ("4.5 GB of params+momentum ... dies
+RESOURCE_EXHAUSTED at warmup"), and until now that death was an
+unclassified XlaRuntimeError traceback that launch.py burned its whole
+retry budget on — while an ENOSPC during a snapshot save or ledger
+fsync either spun a jittered backoff loop (a full disk does not heal on
+retry) or tore state. Both become typed ANSWERS here:
+
+- :class:`DeviceOOM` — an XLA ``RESOURCE_EXHAUSTED`` launch failure.
+  Deterministic for a given program + population: retrying the same
+  shape re-OOMs, so supervisors must not fund restarts. The wave
+  scheduler's adaptive backoff (train/fused_pbt.py ``--oom-backoff``)
+  is the one productive response: halve the wave and re-run — wave mode
+  is bit-identical at any wave size, so backoff preserves the result.
+- :class:`StorageFull` — ENOSPC/EDQUOT from a durable-state write.
+  Also an answer, not weather: the snapshot layer gets ONE
+  retention-prune retry (utils/checkpoint.py), then the run parks with
+  ``EX_IOERR`` (74) so freeing disk + ``--resume`` recovers.
+
+Funnel contract (machine-checked by the ``resource-funnel`` sweeplint
+checker): RESOURCE_EXHAUSTED / XlaRuntimeError handling and ENOSPC
+errno literals live in THIS module only. Everything else asks
+:func:`is_device_oom` / :func:`is_storage_full` — ad-hoc swallows of
+either class cannot regress the classification silently.
+
+Observer + seams mirror utils/integrity.py: backoff/prune events flow
+through a process-wide observer the CLI wires to its MetricsLogger, and
+the two chaos injectors (workloads/chaos.py ``inject_enospc`` /
+``inject_oom``) install schedules on the module-level fault seams.
+"""
+
+from __future__ import annotations
+
+import errno
+from typing import Callable, Optional
+
+#: the storage-exhaustion errnos: "no space" and "quota exceeded" are
+#: the same operational event (the tenant's writes stop landing until
+#: an operator frees bytes) and classify identically everywhere
+_STORAGE_ERRNOS = (errno.ENOSPC, errno.EDQUOT)
+
+#: message markers an XLA allocation failure arrives with. Checked only
+#: AFTER the type gate (XlaRuntimeError) — a user exception merely
+#: QUOTING "out of memory" must not classify as a device OOM
+_OOM_MARKERS = ("resource_exhausted", "resource exhausted", "out of memory")
+
+
+class DeviceOOM(RuntimeError):
+    """Typed device-memory exhaustion: the program's resident state
+    (population + activations) exceeded the device budget. Carries the
+    original XLA error text; ``wave_size`` is the wave cap in force
+    when the launch died (None for resident mode) so diagnostics can
+    say what to halve."""
+
+    def __init__(self, message: str, wave_size: Optional[int] = None):
+        super().__init__(message)
+        self.wave_size = wave_size
+
+
+class StorageFull(OSError):
+    """Typed storage exhaustion (ENOSPC semantics preserved: this IS an
+    OSError with the original errno, so ``is_storage_full`` classifies
+    it and errno-aware callers keep working)."""
+
+    def __init__(self, message: str, path: Optional[str] = None, err: int = errno.ENOSPC):
+        super().__init__(err, message, path)
+
+
+def is_storage_full(e: BaseException) -> bool:
+    """Is this exception a storage-exhaustion ANSWER (ENOSPC/EDQUOT)?
+    The one predicate every retry loop and save path consults — a full
+    disk must never spin a backoff schedule. Walks the EXPLICIT cause
+    chain (``raise X from e``): orbax/tensorstore surface a background
+    write's ENOSPC wrapped in their own error types, and the wrapper
+    must classify like the root cause."""
+    depth = 0
+    while isinstance(e, BaseException) and depth < 8:
+        if isinstance(e, OSError) and e.errno in _STORAGE_ERRNOS:
+            return True
+        e = e.__cause__
+        depth += 1
+    return False
+
+
+def storage_full_error(path: str, op: str = "write") -> StorageFull:
+    """Constructor for injectors and wrappers: a classified
+    ``StorageFull`` naming the operation and path."""
+    return StorageFull(f"no space left on device during {op}", path=path)
+
+
+def is_device_oom(e: BaseException) -> bool:
+    """Is this exception an XLA device-memory exhaustion? Type-first
+    (same discipline as cli._is_transient): only the runtime's own
+    error class (``XlaRuntimeError``) is eligible, then the message
+    must carry a RESOURCE_EXHAUSTED marker."""
+    if isinstance(e, DeviceOOM):
+        return True
+    try:
+        import jax.errors
+    except Exception:  # pragma: no cover - jax-less environment
+        return False
+    if not isinstance(e, jax.errors.JaxRuntimeError):
+        return False
+    return any(m in str(e).lower() for m in _OOM_MARKERS)
+
+
+def as_device_oom(e: BaseException, wave_size: Optional[int] = None) -> Optional[DeviceOOM]:
+    """``DeviceOOM`` wrapping ``e`` when it classifies, else None."""
+    if isinstance(e, DeviceOOM):
+        return e
+    if not is_device_oom(e):
+        return None
+    return DeviceOOM(f"{type(e).__name__}: {e}"[:2000], wave_size=wave_size)
+
+
+def synthetic_resource_exhausted(detail: str = "chaos-injected"):
+    """A constructed ``XlaRuntimeError`` with the RESOURCE_EXHAUSTED
+    shape — what the chaos ``oom`` fault raises so drills exercise the
+    REAL classification path (type gate included), not a stand-in."""
+    import jax.errors
+
+    return jax.errors.JaxRuntimeError(
+        f"RESOURCE_EXHAUSTED: Out of memory ({detail})"
+    )
+
+
+class oom_funnel:
+    """Context manager: XLA RESOURCE_EXHAUSTED escaping the guarded
+    region re-raises as typed :class:`DeviceOOM` (everything else
+    propagates raw). The fused launch paths wrap their dispatches in
+    this so the CLI and the wave scheduler's backoff catch ONE type."""
+
+    def __init__(self, wave_size: Optional[int] = None):
+        self.wave_size = wave_size
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is None:
+            return False
+        oom = None if isinstance(exc, DeviceOOM) else as_device_oom(exc, self.wave_size)
+        if oom is not None:
+            raise oom from exc
+        return False
+
+
+# -- observer (utils/integrity.py pattern) ----------------------------------
+#
+# The wave scheduler and checkpoint layer have no metrics handle (they
+# run deep inside fused sweeps); backoff/prune events flow through this
+# process-wide observer, which the CLI points at metrics.log + the
+# oom_backoffs / wave_resized / snapshots_pruned counters.
+
+_OBSERVER: Optional[Callable] = None
+
+
+def set_observer(cb: Optional[Callable]) -> None:
+    global _OBSERVER
+    _OBSERVER = cb
+
+
+def clear_observer() -> None:
+    set_observer(None)
+
+
+def notify(event: str, **fields) -> None:
+    """Report a resource-lifecycle event (``oom_backoff`` /
+    ``wave_resized`` / ``snapshot_pruned``); falls back to a warning so
+    library callers still see backoffs happen."""
+    if _OBSERVER is not None:
+        _OBSERVER(event, **fields)
+        return
+    import warnings
+
+    warnings.warn(f"{event}: {fields}", RuntimeWarning, stacklevel=2)
+
+
+# -- chaos seams ------------------------------------------------------------
+#
+# Direct-call injector hooks, like workloads/chaos.py's snapshot
+# injectors: deterministic schedules installed for a drill, uninstalled
+# in a finally. ``disk_fault(op, path)`` sits inside the atomic-write/
+# fsync paths (snapshot save enqueue, ledger fsync) and may raise a
+# classified StorageFull; ``launch_fault(kind)`` sits at the top of
+# every guarded fused launch (resident launch / one wave) and may raise
+# a synthetic RESOURCE_EXHAUSTED at a chosen ordinal.
+
+_DISK_FAULTS: Optional[Callable[[str, str], None]] = None
+_LAUNCH_FAULTS: Optional[Callable[[str], None]] = None
+
+
+def set_disk_fault_injector(fn: Optional[Callable[[str, str], None]]) -> None:
+    global _DISK_FAULTS
+    _DISK_FAULTS = fn
+
+
+def disk_fault(op: str, path: str) -> None:
+    if _DISK_FAULTS is not None:
+        _DISK_FAULTS(op, path)
+
+
+def set_launch_fault_injector(fn: Optional[Callable[[str], None]]) -> None:
+    global _LAUNCH_FAULTS
+    _LAUNCH_FAULTS = fn
+
+
+def launch_fault(kind: str) -> None:
+    if _LAUNCH_FAULTS is not None:
+        _LAUNCH_FAULTS(kind)

@@ -180,6 +180,144 @@ def inject_corrupt_save(checkpoint_dir: str, seed: int = 0, step=None) -> str:
     return path
 
 
+# -- resource-exhaustion injectors (device OOM / disk full, ISSUE 13) -------
+#
+# Two more direct-call injectors in the inject_torn_save style: install
+# a seeded deterministic schedule, drive the drill, uninstall in a
+# finally. ``inject_enospc`` strikes the atomic-write/fsync paths of
+# the DURABLE layers (snapshot save enqueue, ledger journal fsync) via
+# the resource layer's disk-fault seam — the shape a filling disk
+# presents; ``inject_oom`` raises a synthetic XLA RESOURCE_EXHAUSTED at
+# a chosen guarded fused-launch ordinal (resident launch or wave) via
+# the launch seam, exercising the REAL classification path
+# (utils/resources.py type gate included) and the wave scheduler's
+# --oom-backoff re-run.
+
+
+class DiskFullInjector:
+    """The schedule ``inject_enospc`` installs into
+    ``utils.resources``' disk-fault seam. Counts every seam op per kind
+    ("snapshot_save" / "ledger_fsync") and raises a classified
+    ``StorageFull`` (ENOSPC) on the scheduled ordinals; ``fail_from``
+    makes every op at/after that ordinal fail — the disk-stays-full
+    shape drill B needs (the prune retry must ALSO hit the wall).
+    Thread-safe (orbax save enqueues and the main loop share the
+    seam)."""
+
+    def __init__(
+        self,
+        fail: int = 0,
+        seed: int = 0,
+        ops_window: int | None = None,
+        fail_from: int | None = None,
+        op: str | None = None,
+    ):
+        import threading
+
+        self._lock = threading.Lock()
+        self._counts: dict = {}
+        self._op = op  # None = every seam kind
+        self._fail_from = fail_from
+        self._fail = SpoolFaultInjector._schedule("disk", fail, seed, ops_window)
+        self.faults_fired = 0
+
+    def __call__(self, op: str, path: str) -> None:
+        if self._op is not None and op != self._op:
+            return
+        with self._lock:
+            ordinal = self._counts.get(op, 0)
+            self._counts[op] = ordinal + 1
+            fire = ordinal in self._fail or (
+                self._fail_from is not None and ordinal >= self._fail_from
+            )
+            if fire:
+                self.faults_fired += 1
+        if fire:
+            from mpi_opt_tpu.utils.resources import storage_full_error
+
+            raise storage_full_error(path, op=f"chaos-injected {op} (op {ordinal})")
+
+
+def inject_enospc(
+    fail: int = 0,
+    seed: int = 0,
+    ops_window: int | None = None,
+    fail_from: int | None = None,
+    op: str | None = None,
+):
+    """Install a seeded, deterministic ENOSPC schedule on the durable
+    layers' atomic-write/fsync seam (``utils.resources.disk_fault``:
+    snapshot saves + ledger fsyncs). Returns ``(injector, uninstall)``
+    — call ``uninstall()`` when the drill is over (tests in a finally).
+    ``fail_from=N`` fails every op at/after ordinal N (disk fills and
+    STAYS full — the prune-then-park drill); ``fail=n`` fails the first
+    n (or a seeded sample of ``ops_window``); ``op`` restricts the
+    schedule to one seam kind."""
+    from mpi_opt_tpu.utils import resources
+
+    injector = DiskFullInjector(
+        fail=fail, seed=seed, ops_window=ops_window, fail_from=fail_from, op=op
+    )
+    resources.set_disk_fault_injector(injector)
+
+    def uninstall() -> None:
+        resources.set_disk_fault_injector(None)
+
+    return injector, uninstall
+
+
+class OOMInjector:
+    """The schedule ``inject_oom`` installs into ``utils.resources``'
+    launch seam: every guarded fused launch (resident launch / one
+    wave) ticks one ordinal; the scheduled ordinals (1-based, matching
+    "OOM at wave k") raise a synthetic RESOURCE_EXHAUSTED through the
+    real classification funnel."""
+
+    def __init__(self, at_launch: int = 1, n: int = 1, kind: str | None = None):
+        import threading
+
+        if at_launch < 1:
+            raise ValueError(f"at_launch is 1-based, got {at_launch}")
+        self._lock = threading.Lock()
+        self._kind = kind  # None = any guarded launch ("launch"/"wave")
+        self._fire_at = frozenset(range(at_launch, at_launch + max(1, n)))
+        self.launches = 0
+        self.faults_fired = 0
+
+    def __call__(self, kind: str) -> None:
+        if self._kind is not None and kind != self._kind:
+            return
+        with self._lock:
+            self.launches += 1
+            ordinal = self.launches
+            fire = ordinal in self._fire_at
+            if fire:
+                self.faults_fired += 1
+        if fire:
+            from mpi_opt_tpu.utils.resources import synthetic_resource_exhausted
+
+            raise synthetic_resource_exhausted(
+                f"chaos: injected device OOM at {kind} ordinal {ordinal}"
+            )
+
+
+def inject_oom(at_launch: int = 1, n: int = 1, kind: str | None = None):
+    """Install a deterministic device-OOM schedule on the fused launch
+    seam: the ``at_launch``-th guarded launch (1-based; ``n``
+    consecutive ordinals — n>1 drills repeated backoff) raises a
+    synthetic XLA RESOURCE_EXHAUSTED. Returns ``(injector,
+    uninstall)``. ``kind`` restricts to "launch" (resident) or "wave"."""
+    from mpi_opt_tpu.utils import resources
+
+    injector = OOMInjector(at_launch=at_launch, n=n, kind=kind)
+    resources.set_launch_fault_injector(injector)
+
+    def uninstall() -> None:
+        resources.set_launch_fault_injector(None)
+
+    return injector, uninstall
+
+
 # -- spool-fault injectors (fleet federation, ISSUE 12) ---------------------
 #
 # The two injectors above strike durable state BETWEEN runs; these

@@ -18,6 +18,8 @@
 #        T1_SKIP_PERFDIFF_DRILL=1 probes/tier1.sh # skip the trace-diff gate drill
 #        T1_SKIP_TIMELINE_DRILL=1 probes/tier1.sh # skip the timeline/bubble drill
 #        T1_SKIP_LINT_DRILL=1 probes/tier1.sh # skip the sweeplint drill
+#        T1_SKIP_OOM_DRILL=1 probes/tier1.sh # skip the device-OOM backoff drill
+#        T1_SKIP_ENOSPC_DRILL=1 probes/tier1.sh # skip the disk-full drill
 set -o pipefail
 cd "$(dirname "$0")/.."
 T1_LOG="${T1_LOG:-/tmp/_t1.log}"
@@ -395,6 +397,90 @@ PYEOF
         echo "TIMELINE_DRILL=pass"
     else
         echo "TIMELINE_DRILL=FAIL"
+        rc=$(( rc == 0 ? 1 : rc ))
+    fi
+fi
+
+# -- device-OOM drill (adaptive wave backoff, utils/resources.py) --
+# Chaos drill A: a wave-mode fused PBT sweep with a synthetic XLA
+# RESOURCE_EXHAUSTED injected at wave 3 (generation 2, wave 1) must
+# COMPLETE via automatic wave-size backoff — the wave halves, the
+# generation re-runs — with a ledger record-identical to an unfaulted
+# run's (wave mode is bit-identical at any wave size, which is what
+# makes the backoff safe), and both journals must pass report
+# --validate.
+if [ -z "$T1_SKIP_OOM_DRILL" ]; then
+    om_rc=0
+    OD=$(mktemp -d /tmp/_t1_oom.XXXXXX)
+    timeout -k 10 240 env JAX_PLATFORMS=cpu python - "$OD" >/dev/null 2>&1 <<'PYEOF' || om_rc=1
+import json, sys
+from mpi_opt_tpu.cli import main
+d = sys.argv[1]
+args = ["--workload", "fashion_mlp", "--algorithm", "pbt", "--fused",
+        "--no-mesh", "--population", "4", "--generations", "2",
+        "--steps-per-generation", "2", "--seed", "0", "--wave-size", "2"]
+assert main(args + ["--ledger", f"{d}/clean.jsonl"]) == 0
+from mpi_opt_tpu.workloads.chaos import inject_oom
+inj, un = inject_oom(at_launch=3, kind="wave")  # gen 2, wave 1
+try:
+    assert main(args + ["--ledger", f"{d}/oom.jsonl", "--oom-backoff", "2"]) == 0
+finally:
+    un()
+assert inj.faults_fired == 1, inj.faults_fired  # the OOM really struck
+keep = ("trial_id", "member", "boundary", "params", "status", "score", "step")
+rec = lambda p: [{k: r.get(k) for k in keep}
+                 for r in map(json.loads, open(p).read().splitlines()[1:])]
+assert rec(f"{d}/clean.jsonl") == rec(f"{d}/oom.jsonl"), "ledger diverged"
+PYEOF
+    for L in clean oom; do
+        timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+            report --validate "$OD/$L.jsonl" >/dev/null 2>&1 || om_rc=1
+    done
+    rm -rf "$OD"
+    if [ $om_rc -eq 0 ]; then
+        echo "OOM_DRILL=pass"
+    else
+        echo "OOM_DRILL=FAIL"
+        rc=$(( rc == 0 ? 1 : rc ))
+    fi
+fi
+
+# -- disk-full drill (ENOSPC prune-then-park, utils/resources.py) --
+# Chaos drill B: an injected ENOSPC during a snapshot save (a disk
+# that fills and STAYS full) gets exactly one retention-prune retry
+# (the oldest superseded step reclaimed, the newest verified step
+# never touched) and then parks with exit 74 — no torn step, nothing
+# quarantined. After the injector clears, the ordinary --resume
+# completes and fsck + report --validate exit 0.
+if [ -z "$T1_SKIP_ENOSPC_DRILL" ]; then
+    en_rc=0
+    ED=$(mktemp -d /tmp/_t1_enospc.XXXXXX)
+    timeout -k 10 180 env JAX_PLATFORMS=cpu python - "$ED" >/dev/null 2>&1 <<'PYEOF' || en_rc=1
+import sys
+from mpi_opt_tpu.cli import main
+from mpi_opt_tpu.workloads.chaos import inject_enospc
+d = sys.argv[1]
+args = ["--workload", "quadratic", "--algorithm", "random", "--trials", "8",
+        "--budget", "3", "--workers", "1", "--seed", "0",
+        "--checkpoint-dir", f"{d}/ck", "--ledger", f"{d}/sweep.jsonl"]
+inj, un = inject_enospc(fail_from=2, op="snapshot_save")
+try:
+    rc = main(args)
+finally:
+    un()
+assert rc == 74, rc                    # classified park, not a traceback
+assert inj.faults_fired == 2, inj.faults_fired  # first hit + ONE prune retry
+assert main(args + ["--resume"]) == 0  # disk "freed": ordinary resume
+PYEOF
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        fsck "$ED/ck" >/dev/null 2>&1 || en_rc=1
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        report --validate "$ED/sweep.jsonl" >/dev/null 2>&1 || en_rc=1
+    rm -rf "$ED"
+    if [ $en_rc -eq 0 ]; then
+        echo "ENOSPC_DRILL=pass"
+    else
+        echo "ENOSPC_DRILL=FAIL"
         rc=$(( rc == 0 ? 1 : rc ))
     fi
 fi

@@ -58,6 +58,7 @@ def snapshot() -> dict:
         "slice_hook": shutdown._SLICE_HOOK,
         "beat_listener": heartbeat._LISTENER,
         "spool_faults": _spool_faults(),
+        "resource_state": _resource_state(),
     }
 
 
@@ -67,6 +68,16 @@ def _spool_faults():
     from mpi_opt_tpu.service import spool
 
     return spool._FAULTS
+
+
+def _resource_state():
+    # the resource-exhaustion layer's process globals (ISSUE 13): the
+    # event observer plus the two chaos seams (inject_enospc /
+    # inject_oom) — a leaked injector would fault every later test's
+    # snapshot saves or launches
+    from mpi_opt_tpu.utils import resources
+
+    return (resources._OBSERVER, resources._DISK_FAULTS, resources._LAUNCH_FAULTS)
 
 
 def leaks(before: dict) -> list:
@@ -147,5 +158,11 @@ def leaks(before: dict) -> list:
         problems.append(
             "spool fault injector left installed — the uninstall() from "
             "chaos.inject_spool_faults must run in a finally"
+        )
+    if _resource_state() != before["resource_state"]:
+        problems.append(
+            "resource-layer state left installed (observer or "
+            "inject_enospc/inject_oom seam) — clear_observer() / the "
+            "injector's uninstall() must run in a finally"
         )
     return problems

@@ -579,7 +579,7 @@ def test_lint_json_schema_gate(tmp_path, capsys):
     assert {c["id"] for c in rep["checks"]} == {
         "exit-code", "journal-order", "ledger-gate", "atomic-write",
         "ledger-fsync", "drain-swallow", "key-reuse", "host-sync",
-        "event-registry", "lease-write",
+        "event-registry", "lease-write", "resource-funnel",
     }
 
 
