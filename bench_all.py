@@ -27,6 +27,15 @@ Per-config definitions (from BASELINE.json `configs`):
    v5e, stored-backward — remat off since round 5, an 18% win).
    Measured at the single-chip cap, reported per chip with the cap
    stated.
+6. (beyond BASELINE — ISSUE 14) the suggestion-service tenant: a
+   resident ``--suggest-serve`` server answering suggest→report
+   round trips over the filesystem spool from the batched TPE
+   acquisition kernel. Two numbers: suggestions/s over the whole
+   conversation and the p95 request round-trip — the serving-side
+   counterpart of config 4's raw acquisition throughput (that number
+   is kernel-only; this one pays the full client→spool→server→spool
+   loop an EXTERNAL sweep actually experiences). Not in the default
+   --configs set (BASELINE parity); run with ``--configs 6``.
 """
 
 from __future__ import annotations
@@ -541,6 +550,89 @@ def bench_config5(
     }
 
 
+def bench_config6(seed: int, rounds: int = 8, batch: int = 32):
+    """Suggestion-service round trips (ISSUE 14): a REAL server process
+    (the `--suggest-serve` flat-CLI tenant, so the measurement pays jax
+    bring-up exactly once, outside the timed window) driven through the
+    jax-free client over the filesystem spool. suggestions/s is the
+    headline; p95 round-trip is the serving-latency number; config 4's
+    kernel-only acquisition throughput bounds it from above."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    from mpi_opt_tpu.corpus import client
+
+    sdir = tempfile.mkdtemp(prefix="bench_suggest_")
+    spool = os.path.join(sdir, "spool")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "mpi_opt_tpu",
+            "--workload", "tabular_mlp",
+            "--suggest-serve", spool,
+            "--suggest-idle-timeout", "120",
+            "--seed", str(seed),
+            "--ledger", os.path.join(sdir, "suggest.jsonl"),
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        # readiness probe = the warmup: the first answered suggest means
+        # the server imported jax, built the space, and compiled the
+        # first acquisition variant — all outside the timed window
+        deadline = time.perf_counter() + 300
+        ready = False
+        while time.perf_counter() < deadline:
+            try:
+                client.round_trip(spool, {"op": "suggest", "n": batch}, timeout=10)
+                ready = True
+                break
+            except TimeoutError:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"suggestion server died during bring-up "
+                        f"(rc {proc.returncode})"
+                    )
+        if not ready:
+            raise RuntimeError("suggestion server never became ready")
+        rec = client.bench(spool, rounds=rounds, batch=batch)
+        log(
+            f"[config6] {rec['suggestions']} suggestions in {rec['wall_s']}s "
+            f"-> {rec['suggestions_per_sec']}/s; round-trip "
+            f"p50={rec['round_trip_p50_s']}s p95={rec['round_trip_p95_s']}s"
+        )
+    finally:
+        client.request_stop(spool)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        shutil.rmtree(sdir, ignore_errors=True)
+    return {
+        "config": 6,
+        "metric": "suggest_service_suggestions_per_sec",
+        "value": rec["suggestions_per_sec"],
+        "unit": "suggestions/sec",
+        "hardware": "server subprocess (default platform), filesystem spool",
+        "rounds": rec["rounds"],
+        "batch": rec["batch"],
+        "requests": rec["requests"],
+        "round_trip_p50_s": rec["round_trip_p50_s"],
+        "round_trip_p95_s": rec["round_trip_p95_s"],
+        "wall_s": rec["wall_s"],
+        "transport_note": (
+            "every suggestion was also reported back (one report round "
+            "trip per suggestion), so the figure measures the full "
+            "suggest→evaluate→report conversation an external sweep "
+            "drives, not kernel throughput (config 4 measures that)"
+        ),
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--configs", default="1,2,3,4,5")
@@ -620,6 +712,7 @@ def main():
             args.seed, args.c5_population, args.c5_member_chunk,
             args.c5_learn_gens, args.c5_learn_target,
         ),
+        "6": lambda: bench_config6(args.seed),
     }
     # validate BEFORE measuring: a bad token must not cost a bench run
     wanted = [c.strip() for c in args.configs.split(",") if c.strip()]

@@ -40,6 +40,7 @@ from mpi_opt_tpu.analysis.core import (  # noqa: F401
 def all_checkers():
     """One fresh instance of every registered checker (stateless between
     files by contract; a fresh set per run keeps that honest)."""
+    from mpi_opt_tpu.analysis.checkers_corpus import CorpusIndexWriteChecker
     from mpi_opt_tpu.analysis.checkers_drain import DrainSwallowChecker
     from mpi_opt_tpu.analysis.checkers_durability import (
         AtomicWriteChecker,
@@ -64,5 +65,6 @@ def all_checkers():
         HostSyncChecker(),
         EventRegistryChecker(),
         LeaseWriteChecker(),
+        CorpusIndexWriteChecker(),
         ResourceFunnelChecker(),
     ]

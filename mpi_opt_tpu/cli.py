@@ -190,13 +190,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--warm-start",
         default=None,
-        metavar="PATH",
-        help="feed a PRIOR sweep's ledger into this sweep as "
-        "observations before the search starts (TPE/BOHB build "
-        "surrogate priors — fused TPE pre-fills its on-device ring; "
-        "random/asha/pbt seed with the prior best). CROSS-MODE: a "
-        "fused ledger warm-starts a driver sweep and vice versa; the "
-        "only gate is the space hash",
+        metavar="PATH|auto:DIR",
+        help="feed PRIOR sweep evidence into this sweep as observations "
+        "before the search starts (TPE/BOHB build surrogate priors — "
+        "fused TPE pre-fills its on-device ring; random/asha/pbt seed "
+        "with the prior best). A PATH names one prior ledger (CROSS-"
+        "MODE: a fused ledger warm-starts a driver sweep and vice "
+        "versa; the only gate is the space hash). 'auto:DIR' resolves "
+        "through DIR's corpus index instead (`corpus index DIR`): "
+        "every exact-space-hash ledger merges in (dedup by canonical "
+        "params, newest wins) and fuzzy-matched same-workload ledgers "
+        "enter down-weighted at budget 0; stale index entries degrade "
+        "to corpus_skip events, never errors",
     )
     # checkpoint/resume (SURVEY.md §2 row 13, §5)
     p.add_argument(
@@ -449,6 +454,30 @@ def build_parser() -> argparse.ArgumentParser:
         "supervisor wires this per rank automatically; set manually "
         "for external watchdogs",
     )
+    # the suggestion service (corpus/serve.py): instead of running a
+    # sweep, answer suggest/report/lookup traffic for EXTERNAL sweeps
+    p.add_argument(
+        "--suggest-serve",
+        default=None,
+        metavar="DIR",
+        help="run as a resident suggestion server over this filesystem "
+        "spool instead of sweeping: answers suggest/report/lookup "
+        "requests (`suggest-client`) from the batched TPE acquisition "
+        "kernel over --workload's space, warm-started via --warm-start "
+        "(incl. auto:DIR). Submittable to the sweep service unchanged "
+        "— every served request is a natural boundary, so `serve` "
+        "time-slices it like a sweep; with --ledger every report "
+        "journals and --resume rebuilds the ring",
+    )
+    p.add_argument(
+        "--suggest-idle-timeout",
+        type=float,
+        default=None,
+        metavar="S",
+        help="with --suggest-serve: exit 0 (done) after S seconds with "
+        "no requests (unset = stay resident until `suggest-client stop` "
+        "or a drain)",
+    )
     return p
 
 
@@ -627,6 +656,87 @@ def _has_snapshot(directory) -> bool:
     return False
 
 
+def _resolve_warm_start(args, space, metrics, parser):
+    """ONE home for ``--warm-start`` resolution (ISSUE 14 satellite:
+    the load/validate block used to be written twice — fused and driver
+    — and the realpath self-warm-start guard protected only the flat
+    main() flow; now every path, the ``auto:`` corpus resolution and
+    the suggestion tenant included, flows through here).
+
+    Returns ``(warm_obs, warm_info)``: the observations to ingest and
+    the event-payload dict (``sources`` naming every contributing
+    ledger with its match kind, ``skips`` counting per-record losses).
+    Usage errors (bad path, space-hash mismatch, self-warm-start,
+    malformed auto spec) surface as ``parser.error`` — exit 2, before
+    any durable state is touched."""
+    import os
+
+    from mpi_opt_tpu.ledger import LedgerError
+
+    spec = args.warm_start
+    if spec == "auto" or spec.startswith("auto:"):
+        corpus_dir = spec[len("auto:"):] if spec.startswith("auto:") else ""
+        if not corpus_dir:
+            parser.error(
+                "--warm-start auto needs a corpus root: --warm-start auto:DIR"
+            )
+        if not os.path.isdir(corpus_dir):
+            parser.error(
+                f"--warm-start auto: {corpus_dir!r} is not a directory"
+            )
+        from mpi_opt_tpu.corpus.resolve import resolve
+
+        # exclude= is the auto-path self-warm-start guard: this run's
+        # own --ledger may already live under the corpus root
+        res = resolve(
+            space,
+            corpus_dir,
+            workload=args.workload,
+            exclude=args.ledger,
+            metrics=metrics,
+        )
+        # degraded whole entries already surfaced as corpus_skip events
+        # inside resolve(); the warm_start payload carries the sources
+        # that DID contribute plus the per-record loss counters
+        return res.observations, {
+            "sources": res.sources,
+            "skips": res.skips or None,
+        }
+    # plain path: one PRIOR ledger. realpath: './sweep.jsonl' vs
+    # 'sweep.jsonl' (or a symlink) is still self-feeding — this run's
+    # journal is not a prior sweep
+    if args.ledger and os.path.realpath(spec) == os.path.realpath(args.ledger):
+        parser.error(
+            "--warm-start must name a PRIOR sweep's ledger, not this "
+            "run's --ledger (resuming this sweep is --ledger --resume)"
+        )
+    from mpi_opt_tpu.ledger.warmstart import load_observations
+
+    try:
+        obs, skips = load_observations(spec, space)
+    except (LedgerError, OSError) as e:
+        parser.error(f"--warm-start: {e}")
+    return obs, {
+        "sources": [{"path": spec, "match": "exact", "records": len(obs)}],
+        "skips": skips or None,
+    }
+
+
+def _log_warm_start(metrics, args, warm_info, observations: int) -> None:
+    """The one ``warm_start`` event shape, shared by every path:
+    ``observations`` is what actually informed the search (the
+    algorithm's own count where one exists), ``sources`` names the
+    chosen ledgers, ``skipped`` carries the per-record loss counters
+    instead of letting the list silently shrink."""
+    metrics.log(
+        "warm_start",
+        path=args.warm_start,
+        observations=observations,
+        sources=(warm_info or {}).get("sources"),
+        skipped=(warm_info or {}).get("skips"),
+    )
+
+
 def run_fused(args, parser, workload) -> int:
     """--fused: the whole sweep as on-device programs, no driver loop.
 
@@ -708,15 +818,8 @@ def run_fused(args, parser, workload) -> int:
     # not be journaled into a fresh ledger's identity
     warm_obs = None
     if args.warm_start:
-        from mpi_opt_tpu.ledger.warmstart import load_observations
-
-        try:
-            warm_obs = load_observations(args.warm_start, space)
-        except (LedgerError, OSError) as e:
-            parser.error(f"--warm-start: {e}")
-        metrics.log(
-            "warm_start", path=args.warm_start, observations=len(warm_obs)
-        )
+        warm_obs, warm_info = _resolve_warm_start(args, space, metrics, parser)
+        _log_warm_start(metrics, args, warm_info, len(warm_obs))
     ledger = _open_fused_ledger(args, parser, space, metrics)
     t0 = time.perf_counter()
     try:
@@ -860,7 +963,9 @@ def _open_fused_ledger(args, parser, space, metrics):
     else:  # hyperband / bohb
         config.update(max_budget=args.max_budget, eta=args.eta)
     try:
-        ledger.ensure_header(config)
+        # space_spec rides the header top-level (not identity): the
+        # corpus index fuzzy-fingerprints ledgers from it
+        ledger.ensure_header(config, space_spec=space.spec())
     except LedgerError as e:
         parser.error(f"--ledger: {e}")
     if ledger.n_torn:
@@ -1052,6 +1157,124 @@ def _run_fused_dispatch(
     return 0
 
 
+def run_suggest_serve(args, parser, workload) -> int:
+    """--suggest-serve DIR: the suggestion-service tenant (corpus/serve).
+
+    Instead of sweeping, this process answers suggest/report/lookup
+    traffic over DIR at acquisition-kernel speed. Lifecycle mirrors a
+    sweep's exactly so the sweep service can own it: a drain request
+    (slice budget, SIGTERM, cancel) parks it with EX_TEMPFAIL — every
+    report is already fsync-journaled, so nothing is lost — and
+    ``--ledger --resume`` rebuilds the observation ring on the next
+    slice; the stop flag / idle timeout completes it (exit 0)."""
+    from mpi_opt_tpu.corpus.serve import SuggestServer, serve_loop
+    from mpi_opt_tpu.ledger import LedgerError, SweepLedger
+
+    space = workload.default_space()
+    metrics = stdout_logger(path=args.metrics_file, n_chips=1)
+    _wire_trace(args, metrics)  # restored by main's finally
+    server = SuggestServer(space, seed=args.seed)
+    # corpus warm start resolves BEFORE the ledger header commits, the
+    # same ordering rule as the sweep paths
+    warm_obs = warm_info = None
+    if args.warm_start:
+        warm_obs, warm_info = _resolve_warm_start(args, space, metrics, parser)
+    ledger = None
+    if args.ledger:
+        try:
+            # the suggestion server is single-process by construction
+            # (it owns its spool dir; SPMD bring-up never reaches this
+            # branch), so the rank gate is constantly writable
+            ledger = SweepLedger(args.ledger, read_only=False)
+        except LedgerError as e:
+            parser.error(f"--ledger: {e}")
+        if ledger.records and not args.resume:
+            parser.error(
+                f"--ledger {args.ledger!r} already holds "
+                f"{len(ledger.records)} report records; pass --resume to "
+                "rebuild the ring from them, or point at a fresh path"
+            )
+        try:
+            ledger.ensure_header(
+                {
+                    "mode": "suggest",
+                    "algorithm": "tpe",
+                    "workload": args.workload,
+                    "backend": "suggest",
+                    "seed": args.seed,
+                    "space_hash": space.space_hash(),
+                    "warm_start": args.warm_start,
+                },
+                space_spec=space.spec(),
+            )
+        except LedgerError as e:
+            parser.error(f"--ledger: {e}")
+        if ledger.n_torn:
+            metrics.log("ledger_torn_tail_dropped", path=args.ledger)
+        if ledger.records:
+            # resume: the server's own journaled reports rebuild the
+            # ring + exact cache (and the report serial continues past
+            # them, so records never alias across slices)
+            server.seed_from_ledger(ledger.records)
+            metrics.log("ledger_replay", completed=len(ledger.records))
+    if warm_obs is not None:
+        n_warm = server.ingest(warm_obs)
+        _log_warm_start(metrics, args, warm_info, n_warm)
+    metrics.log(
+        "suggest_serve",
+        workload=args.workload,
+        n_obs=server._n_obs,
+    )
+    try:
+        summary = serve_loop(
+            server,
+            args.suggest_serve,
+            metrics,
+            ledger=ledger,
+            idle_timeout=args.suggest_idle_timeout,
+        )
+    except SweepInterrupted as e:
+        # the drain park: every report the clients saw acked is already
+        # fsync-journaled, so the park is free — EX_TEMPFAIL tells the
+        # scheduler/supervisor "resume me" exactly like a sweep
+        metrics.count_preempted()
+        metrics.summary(final=True)
+        print(
+            json.dumps(
+                {
+                    "preempted": True,
+                    "signal": e.signal,
+                    "at": e.at,
+                    "workload": args.workload,
+                    "backend": "suggest",
+                }
+            )
+        )
+        print(
+            f"graceful shutdown ({e.signal}) at {e.at}: reports journaled; "
+            f"relaunch with --resume to continue (exit {EX_TEMPFAIL})",
+            file=sys.stderr,
+        )
+        return EX_TEMPFAIL
+    finally:
+        if ledger is not None:
+            ledger.close()
+    metrics.summary(final=True)
+    print(
+        json.dumps(
+            _finite_or_null(
+                {
+                    "workload": args.workload,
+                    "algorithm": "suggest",
+                    "backend": "suggest",
+                    **summary,
+                }
+            )
+        )
+    )
+    return 0
+
+
 def main(argv=None, *, _workload=None) -> int:
     """CLI entrypoint. ``_workload`` is the sweep service's injection
     seam (service/programs.py): a resident server passes its cached
@@ -1096,6 +1319,18 @@ def main(argv=None, *, _workload=None) -> int:
         from mpi_opt_tpu.service import service_main
 
         return service_main(argv)
+    # `mpi_opt_tpu corpus index|resolve` maintains/audits the ledger-
+    # corpus knowledge layer (corpus/); `index` never touches jax
+    if argv and argv[0] == "corpus":
+        from mpi_opt_tpu.corpus.cli import corpus_main
+
+        return corpus_main(argv[1:])
+    # `mpi_opt_tpu suggest-client` drives a --suggest-serve server over
+    # its filesystem spool; jax-free like every service client
+    if argv and argv[0] == "suggest-client":
+        from mpi_opt_tpu.corpus.client import client_main
+
+        return client_main(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.resume and not (args.checkpoint_dir or args.ledger):
@@ -1157,16 +1392,28 @@ def main(argv=None, *, _workload=None) -> int:
     # --ledger/--warm-start work on BOTH paths: the driver journals per
     # trial, fused sweeps journal per population member at every
     # launch/rung/generation boundary (ledger/fused.py) — and warm-start
-    # is cross-mode (the records share space_hash/canonical params)
-    if args.warm_start and args.ledger:
-        import os
-
-        # realpath: './sweep.jsonl' vs 'sweep.jsonl' (or a symlink) is
-        # still self-feeding — this run's journal is not a prior sweep
-        if os.path.realpath(args.warm_start) == os.path.realpath(args.ledger):
+    # is cross-mode (the records share space_hash/canonical params).
+    # Resolution — including the realpath self-warm-start guard and the
+    # auto: corpus path — lives in _resolve_warm_start, ONE helper every
+    # execution path (driver, fused, suggestion tenant) flows through.
+    if args.suggest_serve:
+        if args.fused:
             parser.error(
-                "--warm-start must name a PRIOR sweep's ledger, not this "
-                "run's --ledger (resuming this sweep is --ledger --resume)"
+                "--suggest-serve answers suggestion traffic instead of "
+                "sweeping; it cannot combine with --fused"
+            )
+        if args.chaos is not None:
+            parser.error(
+                "--chaos injects faults into trial evaluation; a "
+                "--suggest-serve server evaluates nothing"
+            )
+    if args.suggest_idle_timeout is not None:
+        if not args.suggest_serve:
+            parser.error("--suggest-idle-timeout requires --suggest-serve")
+        if args.suggest_idle_timeout <= 0:
+            parser.error(
+                f"--suggest-idle-timeout must be > 0, got "
+                f"{args.suggest_idle_timeout}"
             )
     # persistent compile cache (env-gated), then platform pinning, then
     # multi-host bring-up, BEFORE anything touches the XLA backend
@@ -1245,6 +1492,8 @@ def _run_sweep(args, parser, _workload=None) -> int:
             workload = get_workload("chaos", **chaos_kwargs)
         except ValueError as e:
             parser.error(f"--chaos: {e}")
+    if args.suggest_serve:
+        return run_suggest_serve(args, parser, workload)
     if args.fused:
         return run_fused(args, parser, workload)
     space = workload.default_space()
@@ -1317,15 +1566,9 @@ def _run_sweep(args, parser, _workload=None) -> int:
     # this run's own ledger header commits: a typo'd --warm-start path
     # must fail before it is journaled into a fresh ledger's identity,
     # which would refuse the corrected re-run
-    warm_obs = None
+    warm_obs = warm_info = None
     if args.warm_start:
-        from mpi_opt_tpu.ledger import LedgerError
-        from mpi_opt_tpu.ledger.warmstart import load_observations
-
-        try:
-            warm_obs = load_observations(args.warm_start, space)
-        except (LedgerError, OSError) as e:
-            parser.error(f"--warm-start: {e}")
+        warm_obs, warm_info = _resolve_warm_start(args, space, metrics, parser)
     ledger = None
     if args.ledger:
         from mpi_opt_tpu.ledger import LedgerError, SweepLedger
@@ -1357,6 +1600,7 @@ def _run_sweep(args, parser, _workload=None) -> int:
         try:
             # the sweep's identity: everything that shapes the
             # deterministic suggestion stream the replay relies on
+            # (space_spec rides top-level — corpus metadata, not identity)
             ledger.ensure_header(
                 {
                     "algorithm": args.algorithm,
@@ -1369,7 +1613,8 @@ def _run_sweep(args, parser, _workload=None) -> int:
                     "budget": args.budget,
                     "chaos": args.chaos,
                     "warm_start": args.warm_start,
-                }
+                },
+                space_spec=space.spec(),
             )
         except LedgerError as e:
             parser.error(f"--ledger: {e}")
@@ -1388,9 +1633,7 @@ def _run_sweep(args, parser, _workload=None) -> int:
             )
         else:
             n_warm = algorithm.ingest_observations(warm_obs)
-            metrics.log(
-                "warm_start", path=args.warm_start, observations=n_warm
-            )
+            _log_warm_start(metrics, args, warm_info, n_warm)
     policy = FailurePolicy(
         max_retries=args.trial_retries,
         max_failure_rate=args.max_failure_rate,

@@ -1,0 +1,43 @@
+"""Cross-sweep knowledge corpus: the ledger archive as a queryable prior.
+
+Every sweep this engine runs journals its trial history durably
+(ledger/), every service tenant keeps a per-job ledger (service/), and
+since PR 6 the throughput-dominant fused mode journals at member
+granularity — so a working deployment accumulates a CORPUS of
+evaluated (params, score, budget) facts. Before this package, that
+corpus informed a new sweep only when a human pointed ``--warm-start``
+at one specific file. This package closes the loop (ISSUE 14 /
+ROADMAP "cross-sweep knowledge"):
+
+- ``index``   — ``corpus index DIR``: a persistent, atomically-updated
+  index of every ledger under DIR, keyed by (workload, space_hash,
+  algorithm), with record counts, best scores, and a structural space
+  fingerprint enabling fuzzy matching between different-hash spaces.
+- ``match``   — the fingerprint + compatibility layer: exact identity
+  stays the hash's business; structurally-overlapping spaces score as
+  fuzzy candidates, per-record admission keeps foreign evidence inside
+  the live domain.
+- ``resolve`` — ``--warm-start auto[:DIR]``: exact-hash sources merge
+  (dedup by canonical params, newest wins), fuzzy sources enter
+  down-weighted at budget 0, stale/corrupt index entries degrade to
+  ``corpus_skip`` events — a deleted ledger never kills a sweep.
+- ``serve``   — the suggestion service: a resident (and sweep-service-
+  schedulable) tenant answering suggest → report → lookup over a
+  filesystem spool at acquisition-kernel speed, warm-started from the
+  corpus; its own journal is corpus material for the next index.
+- ``client``  — ``suggest-client``: the jax-free protocol client, with
+  a ``bench`` mode measuring suggestions/s and round-trip percentiles
+  (BENCH config 6).
+"""
+
+from __future__ import annotations
+
+from mpi_opt_tpu.corpus.index import (  # noqa: F401
+    INDEX_NAME,
+    INDEX_VERSION,
+    build_index,
+    index_corpus,
+    index_path,
+    read_index,
+    write_index,
+)

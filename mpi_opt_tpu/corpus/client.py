@@ -1,0 +1,197 @@
+"""``mpi_opt_tpu suggest-client``: the suggestion service's thin client.
+
+jax-free (like every service client): requests are atomic JSON file
+drops, responses are polled reads, so an external sweep written in ANY
+language can drive the suggestion tenant by copying this ~50-line
+protocol. Subcommands::
+
+    suggest-client --dir SDIR suggest -n 8
+    suggest-client --dir SDIR report --params '{"lr": 0.1}' --score 0.93 [--budget 20]
+    suggest-client --dir SDIR lookup --params '{"lr": 0.1}' [--budget 20]
+    suggest-client --dir SDIR stop
+    suggest-client --dir SDIR bench --rounds 32 --batch 16
+
+``bench`` is the measured scenario (BENCH config 6): ``--rounds``
+suggest→report round trips of ``--batch`` suggestions each, every
+suggestion reported back with a synthetic quadratic score — printing
+suggestions/s and the p50/p95 request round-trip, the two numbers the
+ISSUE 14 acceptance names.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+from mpi_opt_tpu.service.spool import _read_json, _write_json_atomic
+
+
+def request(sdir: str, payload: dict) -> str:
+    """Drop one request; returns its id (nanosecond-stamped like spool
+    job ids, so lexicographic order is submission order)."""
+    rid = payload.get("id") or f"req-{time.time_ns():020d}-{os.getpid() % 100000:05d}"
+    req_dir = os.path.join(sdir, "requests")
+    os.makedirs(req_dir, exist_ok=True)
+    _write_json_atomic(
+        os.path.join(req_dir, f"{rid}.json"), dict(payload, id=rid)
+    )
+    return rid
+
+
+def wait_response(
+    sdir: str, rid: str, timeout: float = 30.0, poll: float = 0.01
+) -> Optional[dict]:
+    """Poll for the response; None on timeout (server down or wedged —
+    the caller decides whether that is an error)."""
+    path = os.path.join(sdir, "responses", f"{rid}.json")
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ans = _read_json(path)
+        if ans is not None:
+            try:
+                os.unlink(path)  # consume: responses are single-reader
+            except OSError:
+                pass
+            return ans
+        time.sleep(poll)
+    return None
+
+
+def round_trip(sdir: str, payload: dict, timeout: float = 30.0) -> dict:
+    rid = request(sdir, payload)
+    ans = wait_response(sdir, rid, timeout=timeout)
+    if ans is None:
+        raise TimeoutError(
+            f"no response to {payload.get('op')!r} within {timeout}s — is a "
+            f"suggestion server (--suggest-serve {sdir}) running?"
+        )
+    return ans
+
+
+def request_stop(sdir: str) -> None:
+    ctrl = os.path.join(sdir, "control")
+    os.makedirs(ctrl, exist_ok=True)
+    with open(os.path.join(ctrl, "stop"), "w") as f:
+        f.write("")
+
+
+def _synthetic_score(params: dict) -> float:
+    """The bench's stand-in objective: a deterministic quadratic bowl
+    over the numeric dims (closer to mid-range scores higher), so the
+    served acquisition has a real surface to learn during the bench."""
+    score = 0.0
+    n = 0
+    for v in params.values():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        score -= (float(v) - 0.5) ** 2
+        n += 1
+    return score if n else 0.0
+
+
+def bench(sdir: str, rounds: int, batch: int, timeout: float = 60.0) -> dict:
+    """``rounds`` suggest→report round trips of ``batch`` suggestions,
+    every suggestion reported back: suggestions/s over the whole
+    conversation plus p50/p95 per-request round-trip seconds."""
+    trips: list = []
+
+    def timed(payload):
+        t0 = time.perf_counter()
+        ans = round_trip(sdir, payload, timeout=timeout)
+        trips.append(time.perf_counter() - t0)
+        if ans.get("error"):
+            raise RuntimeError(f"server refused {payload.get('op')!r}: {ans['error']}")
+        return ans
+
+    timed({"op": "suggest", "n": batch})  # warm the jitted acquisition
+    t0 = time.perf_counter()
+    n_suggestions = 0
+    for _ in range(rounds):
+        ans = timed({"op": "suggest", "n": batch})
+        got = ans.get("params") or []
+        n_suggestions += len(got)
+        for params in got:
+            timed(
+                {
+                    "op": "report",
+                    "params": params,
+                    "score": _synthetic_score(params),
+                    "budget": 1,
+                }
+            )
+    wall = time.perf_counter() - t0
+    trips_sorted = sorted(trips)
+
+    def pct(p):
+        return trips_sorted[min(len(trips_sorted) - 1, int(p * len(trips_sorted)))]
+
+    return {
+        "rounds": rounds,
+        "batch": batch,
+        "suggestions": n_suggestions,
+        "requests": len(trips),
+        "wall_s": round(wall, 3),
+        "suggestions_per_sec": round(n_suggestions / max(wall, 1e-9), 2),
+        "round_trip_p50_s": round(pct(0.50), 4),
+        "round_trip_p95_s": round(pct(0.95), 4),
+    }
+
+
+def client_main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="mpi_opt_tpu suggest-client",
+        description="drive a suggestion server (--suggest-serve) over "
+        "its filesystem spool (see README: Cross-sweep knowledge corpus)",
+    )
+    p.add_argument(
+        "--dir",
+        required=True,
+        metavar="SDIR",
+        help="the suggestion spool directory (shared with the server)",
+    )
+    p.add_argument("--timeout", type=float, default=30.0, help="response wait")
+    sub = p.add_subparsers(dest="op", required=True)
+    sp = sub.add_parser("suggest", help="ask for acquisition-ranked points")
+    sp.add_argument("-n", type=int, default=1, help="suggestions to fetch")
+    rp = sub.add_parser("report", help="report one completed evaluation")
+    rp.add_argument("--params", required=True, help="canonical params JSON")
+    rp.add_argument("--score", type=float, required=True)
+    rp.add_argument("--budget", type=int, default=0)
+    lp = sub.add_parser("lookup", help="exact/near-match prior lookup")
+    lp.add_argument("--params", required=True, help="canonical params JSON")
+    lp.add_argument("--budget", type=int, default=0)
+    sub.add_parser("stop", help="flag the server to finish and exit 0")
+    bp = sub.add_parser("bench", help="measured suggest→report round trips")
+    bp.add_argument("--rounds", type=int, default=16)
+    bp.add_argument("--batch", type=int, default=16)
+    args = p.parse_args(argv)
+
+    if args.op == "stop":
+        request_stop(args.dir)
+        print(json.dumps({"stop": True}))
+        return 0
+    try:
+        if args.op == "bench":
+            print(json.dumps(bench(args.dir, args.rounds, args.batch, args.timeout)))
+            return 0
+        payload: dict = {"op": args.op}
+        if args.op == "suggest":
+            payload["n"] = args.n
+        else:
+            try:
+                payload["params"] = json.loads(args.params)
+            except ValueError as e:
+                p.error(f"--params must be JSON: {e}")
+            payload["budget"] = args.budget
+            if args.op == "report":
+                payload["score"] = args.score
+        ans = round_trip(args.dir, payload, timeout=args.timeout)
+    except (TimeoutError, RuntimeError) as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    print(json.dumps(ans))
+    return 0 if not ans.get("error") else 1

@@ -13,7 +13,7 @@ the gap between them — a crash between snapshots loses no completed
 evaluation.
 """
 
-from mpi_opt_tpu.ledger.cache import EvalCache
+from mpi_opt_tpu.ledger.cache import CorpusCache, EvalCache
 from mpi_opt_tpu.ledger.fused import FusedJournal, make_journal
 from mpi_opt_tpu.ledger.store import (
     LEDGER_SCHEMA_VERSION,
@@ -26,6 +26,7 @@ from mpi_opt_tpu.ledger.store import (
 from mpi_opt_tpu.ledger.warmstart import warm_start
 
 __all__ = [
+    "CorpusCache",
     "EvalCache",
     "FusedJournal",
     "LEDGER_SCHEMA_VERSION",

@@ -402,13 +402,21 @@ class SweepLedger:
     def sweep_id(self) -> Optional[str]:
         return None if self.header is None else self.header.get("sweep_id")
 
-    def ensure_header(self, config: dict) -> None:
+    def ensure_header(self, config: dict, space_spec=None) -> None:
         """Write the header (fresh ledger) or verify it (existing one).
 
         ``config`` is the sweep's identity dict; on an existing ledger a
         mismatch on any shared key is refused — the caller is about to
         replay this journal through an algorithm configured differently
         than the one that wrote it.
+
+        ``space_spec`` (``SearchSpace.spec()``) rides the header as a
+        TOP-LEVEL key, deliberately outside ``config``: it is corpus
+        metadata (the structural fingerprint ``corpus index`` uses for
+        fuzzy matching between different-hash spaces), not identity —
+        the hash in ``config`` already settles identity, and folding
+        the spec into the checked dict would refuse every pre-upgrade
+        ledger's resume over a key it never wrote.
         """
         if self.header is not None:
             stale = {
@@ -433,6 +441,8 @@ class SweepLedger:
             "config": dict(config),
             "created_ts": round(time.time(), 4),
         }
+        if space_spec is not None:
+            self.header["space_spec"] = space_spec
         if not self.read_only:
             self._write_line(self.header)
 

@@ -19,6 +19,12 @@ that produced the observations is irrelevant to their evidence value.
 Space compatibility is checked by HASH, not by hope: a ledger written
 for a different space would decode its params into the wrong unit
 coordinates and silently poison the new search, so a mismatch raises.
+WITHIN a hash-matched ledger, individual records that cannot inform
+the search — non-ok status, a missing score, a Choice value no live
+option canonicalizes to — are SKIPPED and COUNTED (``skips``), not
+silently dropped and not fatal: one bit-rotted record must not refuse
+the other thousand, but the ``warm_start`` event must say how many
+records the prior lost on the way in (ISSUE 14 satellite).
 """
 
 from __future__ import annotations
@@ -26,6 +32,10 @@ from __future__ import annotations
 from mpi_opt_tpu.algorithms.base import Algorithm, Observation
 from mpi_opt_tpu.ledger.store import LedgerError, read_ledger
 from mpi_opt_tpu.space import Choice, _plain
+
+#: the per-record skip reasons ``observations_from_records`` counts —
+#: one shared shape so every ``warm_start`` event payload agrees
+SKIP_REASONS = ("not_ok", "bad_choice")
 
 
 def _decode_params(space, params: dict) -> dict:
@@ -53,8 +63,42 @@ def _decode_params(space, params: dict) -> dict:
     return out
 
 
-def load_observations(path: str, space) -> list[Observation]:
-    """A ledger's ok records as Observations for ``space``.
+def observations_from_records(records, space) -> tuple[list, dict]:
+    """ok trial records (ledger JSON shape) -> ``(observations, skips)``.
+
+    ``skips`` counts the records that could NOT become observations,
+    by reason: ``not_ok`` (failed/timeout status or a missing score —
+    nothing to learn from) and ``bad_choice`` (a Choice value no live
+    option canonicalizes to: the hash matched but the record predates
+    an option's repr change, or was hand-edited). Counting instead of
+    raising keeps one damaged record from refusing a thousand good
+    ones, while the caller's ``warm_start`` event carries the honest
+    loss tally instead of a silently shorter observation list.
+    """
+    obs: list[Observation] = []
+    skips = {k: 0 for k in SKIP_REASONS}
+    for rec in records:
+        if rec["status"] != "ok" or rec.get("score") is None:
+            skips["not_ok"] += 1
+            continue
+        try:
+            decoded = _decode_params(space, rec["params"])
+        except LedgerError:
+            skips["bad_choice"] += 1
+            continue
+        obs.append(
+            Observation(
+                unit=space.params_to_unit(decoded),
+                score=float(rec["score"]),
+                budget=int(rec["step"]),
+            )
+        )
+    return obs, {k: v for k, v in skips.items() if v}
+
+
+def load_observations(path: str, space) -> tuple[list, dict]:
+    """A ledger's ok records as Observations for ``space``:
+    ``(observations, skips)`` (see ``observations_from_records``).
 
     Raises LedgerError when the ledger has no header or was written for
     a space whose hash differs from ``space``'s.
@@ -70,18 +114,7 @@ def load_observations(path: str, space) -> list[Observation]:
             "— the prior sweep ran over a different search space, and its "
             "params would decode into the wrong unit coordinates"
         )
-    obs = []
-    for rec in records:
-        if rec["status"] != "ok" or rec.get("score") is None:
-            continue
-        obs.append(
-            Observation(
-                unit=space.params_to_unit(_decode_params(space, rec["params"])),
-                score=float(rec["score"]),
-                budget=int(rec["step"]),
-            )
-        )
-    return obs
+    return observations_from_records(records, space)
 
 
 def best_observation(observations) -> "Observation | None":
@@ -98,5 +131,5 @@ def best_observation(observations) -> "Observation | None":
 def warm_start(algorithm: Algorithm, path: str) -> int:
     """Ingest a prior ledger into ``algorithm``; returns how many
     observations actually informed it (the algorithm's own count)."""
-    obs = load_observations(path, algorithm.space)
+    obs, _skips = load_observations(path, algorithm.space)
     return algorithm.ingest_observations(obs)

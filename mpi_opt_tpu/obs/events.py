@@ -77,6 +77,17 @@ EVENTS = frozenset(
         "tenant_takeover",
         "slice_fenced",
         "server_usurped",
+        # cross-sweep knowledge corpus (corpus/, ISSUE 14):
+        # corpus_skip = one corpus source degraded during --warm-start
+        # auto: resolution (stale index entry whose ledger was deleted/
+        # rewritten, corrupt entry, unreadable ledger) — a skip, never
+        # an error; the suggest_* family is the suggestion service's
+        # lifecycle (serve start, one record per served request, the
+        # stop/idle summary)
+        "corpus_skip",
+        "suggest_serve",
+        "suggest_request",
+        "suggest_stop",
         # span tracing (obs/trace.py): one event kind, span names below
         "span",
     }

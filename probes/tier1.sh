@@ -20,6 +20,7 @@
 #        T1_SKIP_LINT_DRILL=1 probes/tier1.sh # skip the sweeplint drill
 #        T1_SKIP_OOM_DRILL=1 probes/tier1.sh # skip the device-OOM backoff drill
 #        T1_SKIP_ENOSPC_DRILL=1 probes/tier1.sh # skip the disk-full drill
+#        T1_SKIP_CORPUS_DRILL=1 probes/tier1.sh # skip the corpus/auto-warm-start drill
 set -o pipefail
 cd "$(dirname "$0")/.."
 T1_LOG="${T1_LOG:-/tmp/_t1.log}"
@@ -481,6 +482,103 @@ PYEOF
         echo "ENOSPC_DRILL=pass"
     else
         echo "ENOSPC_DRILL=FAIL"
+        rc=$(( rc == 0 ? 1 : rc ))
+    fi
+fi
+
+# -- corpus drill (cross-sweep knowledge layer, corpus/; ISSUE 14) --
+# Index a two-ledger mini-corpus (one exact-hash sweep ledger + one
+# fabricated fuzzy-match ledger over a different-bounds space), run a
+# sweep with `--warm-start auto:CORPUS`, and assert: the warm_start
+# event names BOTH sources (exact + fuzzy), the sweep's ledger is
+# record-identical to a manually-pointed `--warm-start exact.jsonl`
+# run (the fuzzy prior is down-weighted low-fidelity evidence, never a
+# seed-point hijacker), a deleted-ledger stale index entry degrades to
+# a corpus_skip event (rc 0, not an error), and a suggestion server
+# completes live suggest→report round trips over its spool.
+if [ -z "$T1_SKIP_CORPUS_DRILL" ]; then
+    cp_rc=0
+    CP=$(mktemp -d /tmp/_t1_corpus.XXXXXX)
+    timeout -k 10 240 env JAX_PLATFORMS=cpu python - "$CP" >/dev/null 2>&1 <<'PYEOF' || cp_rc=1
+import json, os, sys, threading
+from mpi_opt_tpu.cli import main
+d = sys.argv[1]
+C = os.path.join(d, "corpus"); os.makedirs(C)
+base = ["--workload", "quadratic", "--algorithm", "random", "--budget", "3",
+        "--workers", "1"]
+assert main(base + ["--trials", "6", "--seed", "0",
+                    "--ledger", f"{C}/exact.jsonl"]) == 0
+# the fuzzy prior: same workload + dim names, different bounds (a
+# different hash), every score BELOW the exact best
+from mpi_opt_tpu.ledger import SweepLedger
+from mpi_opt_tpu.space import LogUniform, SearchSpace, Uniform
+from mpi_opt_tpu.trial import TrialResult
+fz = SearchSpace({"lr": LogUniform(0.0005, 8.0), "reg": Uniform(0.0, 2.0)})
+led = SweepLedger(f"{C}/fuzzy.jsonl")
+led.ensure_header({"algorithm": "tpe", "workload": "quadratic",
+                   "backend": "cpu", "seed": 1,
+                   "space_hash": fz.space_hash()}, space_spec=fz.spec())
+for i, (lr, reg, s) in enumerate([(0.01, 0.2, -5.0), (0.1, 0.4, -4.0),
+                                  (1.0, 0.6, -6.0)]):
+    led.record_trial(TrialResult(trial_id=i, score=s, step=3, wall_time=0.1),
+                     fz.canonical_params({"lr": lr, "reg": reg}))
+led.close()
+assert main(["corpus", "index", C]) == 0
+# auto vs manual: record-identical sweep ledgers
+assert main(base + ["--trials", "5", "--seed", "7",
+                    "--ledger", f"{d}/auto.jsonl",
+                    "--warm-start", f"auto:{C}",
+                    "--metrics-file", f"{d}/m.jsonl"]) == 0
+assert main(base + ["--trials", "5", "--seed", "7",
+                    "--ledger", f"{d}/manual.jsonl",
+                    "--warm-start", f"{C}/exact.jsonl"]) == 0
+keep = ("trial_id", "params", "status", "score", "step")
+rec = lambda p: [{k: r[k] for k in keep}
+                 for r in map(json.loads, open(p).read().splitlines()[1:])]
+assert rec(f"{d}/auto.jsonl") == rec(f"{d}/manual.jsonl"), "auto != manual"
+ws = [json.loads(l) for l in open(f"{d}/m.jsonl") if '"warm_start"' in l]
+kinds = {s["match"] for s in ws[0]["sources"]}
+assert kinds == {"exact", "fuzzy"}, ws  # both priors were picked
+# stale index entry (deleted ledger) degrades to a corpus_skip event
+os.unlink(f"{C}/fuzzy.jsonl")
+assert main(base + ["--trials", "3", "--seed", "9",
+                    "--ledger", f"{d}/stale.jsonl",
+                    "--warm-start", f"auto:{C}",
+                    "--metrics-file", f"{d}/m2.jsonl"]) == 0
+skips = [json.loads(l) for l in open(f"{d}/m2.jsonl") if '"corpus_skip"' in l]
+assert skips and "deleted" in skips[0]["reason"], skips
+# suggestion service: live suggest→report round trips over the spool
+from mpi_opt_tpu.corpus import client
+from mpi_opt_tpu.corpus.serve import SuggestServer, serve_loop
+from mpi_opt_tpu.utils.metrics import null_logger
+from mpi_opt_tpu.workloads import get_workload
+space = get_workload("quadratic").default_space()
+server = SuggestServer(space, seed=0)
+S = os.path.join(d, "sugg")
+th = threading.Thread(target=lambda: serve_loop(
+    server, S, null_logger(), poll_seconds=0.01, idle_timeout=60))
+th.start()
+try:
+    ans = client.round_trip(S, {"op": "suggest", "n": 4}, timeout=60)
+    assert len(ans["params"]) == 4, ans
+    for p in ans["params"]:
+        r = client.round_trip(S, {"op": "report", "params": p,
+                                  "score": 0.5, "budget": 1}, timeout=30)
+        assert r["ok"], r
+finally:
+    client.request_stop(S)
+    th.join(timeout=60)
+assert not th.is_alive()
+PYEOF
+    for L in auto manual stale; do
+        timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+            report --validate "$CP/$L.jsonl" >/dev/null 2>&1 || cp_rc=1
+    done
+    rm -rf "$CP"
+    if [ $cp_rc -eq 0 ]; then
+        echo "CORPUS_DRILL=pass"
+    else
+        echo "CORPUS_DRILL=FAIL"
         rc=$(( rc == 0 ? 1 : rc ))
     fi
 fi

@@ -499,6 +499,65 @@ def test_lease_write_true_negative():
     assert run_one(LeaseWriteChecker(), inside, path="mpi_opt_tpu/service/leases.py") == []
 
 
+# -- corpus-index-write (ISSUE 14) ----------------------------------------
+
+
+def test_corpus_index_write_true_positive():
+    from mpi_opt_tpu.analysis.checkers_corpus import CorpusIndexWriteChecker
+
+    # direct write of the index file outside the helper module
+    f1 = run_one(
+        CorpusIndexWriteChecker(),
+        """
+        import json
+        def persist(doc, corpus_index_path):
+            with open(corpus_index_path, "w") as f:
+                json.dump(doc, f)
+        """,
+        path="corpus/resolve.py",
+    )
+    assert [f.check for f in f1] == ["corpus-index-write"]
+    # rename onto the on-disk name, and deletion out from under readers
+    f2 = run_one(
+        CorpusIndexWriteChecker(),
+        """
+        import os
+        def sneak(tmp, d):
+            os.replace(tmp, d + "/corpus-index.json")
+            os.unlink(d + "/corpus-index.json")
+        """,
+    )
+    assert [f.check for f in f2] == ["corpus-index-write"] * 2
+
+
+def test_corpus_index_write_true_negative():
+    from mpi_opt_tpu.analysis.checkers_corpus import CorpusIndexWriteChecker
+
+    clean = """
+    import json, os
+    def read_side(corpus_index_path, reindex_log):
+        with open(corpus_index_path) as f:   # reads are free
+            doc = json.load(f)
+        with open(reindex_log, "w") as f:    # `reindex` != corpus_index
+            f.write("ok")
+        os.replace("status.tmp", "status.json")  # non-index replace
+        return doc
+    """
+    assert run_one(CorpusIndexWriteChecker(), clean, path="corpus/cli.py") == []
+    # the atomic helper's own home is the one legal writer
+    inside = """
+    import os
+    def write_index(path, tmp):
+        os.replace(tmp, path + "/corpus-index.json")
+    """
+    assert (
+        run_one(
+            CorpusIndexWriteChecker(), inside, path="mpi_opt_tpu/corpus/index.py"
+        )
+        == []
+    )
+
+
 # -- suppression + baseline ----------------------------------------------
 
 
@@ -579,7 +638,8 @@ def test_lint_json_schema_gate(tmp_path, capsys):
     assert {c["id"] for c in rep["checks"]} == {
         "exit-code", "journal-order", "ledger-gate", "atomic-write",
         "ledger-fsync", "drain-swallow", "key-reuse", "host-sync",
-        "event-registry", "lease-write", "resource-funnel",
+        "event-registry", "lease-write", "corpus-index-write",
+        "resource-funnel",
     }
 
 

@@ -1,0 +1,651 @@
+"""Cross-sweep knowledge corpus (ISSUE 14): index, fuzzy matching,
+auto warm-start resolution, the corpus-backed cache, and the
+suggestion service.
+
+The headline is the acceptance drill in miniature: a corpus holding
+one exact-hash and one fuzzy-match ledger resolves into BOTH kinds of
+prior (exact as full observations, fuzzy down-weighted at budget 0),
+the `warm_start` event names the chosen sources, a stale index entry
+degrades to a `corpus_skip` event, and `--warm-start auto:` produces a
+sweep ledger record-identical to a manually-pointed warm start.
+"""
+
+import contextlib
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpi_opt_tpu.algorithms.base import Observation
+from mpi_opt_tpu.cli import main as cli_main
+from mpi_opt_tpu.corpus import index as cindex
+from mpi_opt_tpu.corpus.match import (
+    compat_score,
+    encode_record,
+    fingerprint_from_records,
+    fingerprint_from_spec,
+    fuzzy_observations,
+)
+from mpi_opt_tpu.corpus.resolve import resolve
+from mpi_opt_tpu.ledger import CorpusCache, SweepLedger
+from mpi_opt_tpu.space import LogUniform, SearchSpace, Uniform
+from mpi_opt_tpu.trial import TrialResult
+from mpi_opt_tpu.workloads import get_workload
+
+
+def run_cli(args):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(args)
+    return rc, buf.getvalue()
+
+
+def live_space():
+    return get_workload("quadratic").default_space()
+
+
+def sweep(ledger_path, seed=0, trials=6, warm=None, metrics=None):
+    args = [
+        "--workload", "quadratic", "--algorithm", "random",
+        "--trials", str(trials), "--budget", "3", "--workers", "1",
+        "--seed", str(seed), "--ledger", str(ledger_path),
+    ]
+    if warm:
+        args += ["--warm-start", str(warm)]
+    if metrics:
+        args += ["--metrics-file", str(metrics)]
+    return run_cli(args)
+
+
+def fabricate_ledger(path, space, points, config=None, spec=True):
+    """A hand-built prior ledger over ``space``: points = [(params,
+    score, step)]."""
+    led = SweepLedger(str(path))
+    led.ensure_header(
+        dict(
+            {
+                "algorithm": "tpe",
+                "workload": "quadratic",
+                "backend": "cpu",
+                "seed": 1,
+                "space_hash": space.space_hash(),
+            },
+            **(config or {}),
+        ),
+        space_spec=space.spec() if spec else None,
+    )
+    for i, (params, score, step) in enumerate(points):
+        led.record_trial(
+            TrialResult(trial_id=i, score=score, step=step, wall_time=0.1),
+            space.canonical_params(params),
+        )
+    led.close()
+    return led.path
+
+
+def fuzzy_space():
+    """Same dim names/kinds as quadratic's space, different bounds —
+    a different hash that still structurally overlaps."""
+    return SearchSpace({"lr": LogUniform(0.0005, 8.0), "reg": Uniform(0.0, 2.0)})
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    """One exact-hash sweep ledger + one fabricated fuzzy ledger whose
+    scores are all BELOW the exact best (so auto-vs-manual stays
+    record-identical for seed-point consumers)."""
+    c = tmp_path / "corpus"
+    c.mkdir()
+    rc, _ = sweep(c / "exact.jsonl", seed=0)
+    assert rc == 0
+    fabricate_ledger(
+        c / "fuzzy.jsonl",
+        fuzzy_space(),
+        [
+            ({"lr": 0.01, "reg": 0.2}, -5.0, 3),
+            ({"lr": 0.1, "reg": 0.4}, -4.0, 3),
+            ({"lr": 1.0, "reg": 0.6}, -6.0, 3),
+            ({"lr": 5.0, "reg": 1.5}, -3.0, 3),  # out of the live domain
+        ],
+    )
+    return c
+
+
+# -- fingerprints / fuzzy matching ----------------------------------------
+
+
+def test_fingerprint_spec_and_inference_agree_on_structure():
+    space = live_space()
+    from_spec = fingerprint_from_spec(space.spec())
+    recs = [
+        {"params": {"lr": 0.01, "reg": 0.2}},
+        {"params": {"lr": 2.0, "reg": 0.9}},
+    ]
+    inferred = fingerprint_from_records(recs)
+    assert [r["name"] for r in from_spec] == [r["name"] for r in inferred]
+    assert all(r["kind"] == "numeric" for r in from_spec)
+    assert all(r.get("inferred") for r in inferred)
+    # either form scores full compatibility against the live spec
+    assert compat_score(space.spec(), from_spec) == 1.0
+    assert compat_score(space.spec(), inferred) == 1.0
+
+
+def test_compat_score_judges_name_and_kind():
+    space = live_space()
+    disjoint = fingerprint_from_spec(
+        SearchSpace({"alpha": Uniform(0, 1)}).spec()
+    )
+    assert compat_score(space.spec(), disjoint) == 0.0
+    half = fingerprint_from_spec(
+        SearchSpace({"lr": LogUniform(0.01, 1.0)}).spec()
+    )
+    assert compat_score(space.spec(), half) == pytest.approx(0.5)
+
+
+def test_encode_record_skips_out_of_domain_never_clips():
+    space = live_space()  # lr in [0.001, 4.0], reg in [0, 1]
+    ok = encode_record(space, {"params": {"lr": 0.1, "reg": 0.5}})
+    assert ok is not None and ok.shape == (2,)
+    assert encode_record(space, {"params": {"lr": 5.0, "reg": 0.5}}) is None
+    assert encode_record(space, {"params": {"lr": 0.1}}) is None  # missing dim
+
+
+def test_fuzzy_observations_down_weight_and_budget_zero():
+    space = live_space()
+    recs = [
+        {"params": {"lr": 0.01, "reg": 0.2}, "score": -5.0, "step": 9, "status": "ok"},
+        {"params": {"lr": 0.1, "reg": 0.4}, "score": -4.0, "step": 9, "status": "ok"},
+        {"params": {"lr": 1.0, "reg": 0.6}, "score": -6.0, "step": 9, "status": "ok"},
+        {"params": {"lr": 1.0, "reg": 0.7}, "score": None, "step": 9, "status": "failed"},
+    ]
+    obs, skipped = fuzzy_observations(space, recs)
+    # top half of the 3 encodable survive (ceil(3*0.5)=2), best-first
+    assert [o.score for o in obs] == [-4.0, -5.0]
+    assert all(o.budget == 0 for o in obs)  # lowest fidelity, by contract
+    assert skipped == 2  # the failed record + the dropped worst
+
+
+# -- index -----------------------------------------------------------------
+
+
+def test_index_build_persist_and_incremental_reuse(corpus):
+    doc = cindex.index_corpus(str(corpus))
+    assert os.path.exists(cindex.index_path(str(corpus)))
+    assert len(doc["entries"]) == 2
+    by_name = {os.path.basename(e["path"]): e for e in doc["entries"]}
+    exact = by_name["exact.jsonl"]
+    assert exact["workload"] == "quadratic" and exact["ok"] == 6
+    assert exact["space_hash"] == live_space().space_hash()
+    assert exact["best_score"] is not None
+    assert {r["name"] for r in exact["fingerprint"]} == {"lr", "reg"}
+    # incremental: unchanged ledgers carry over the SAME entry objects
+    doc2 = cindex.build_index(str(corpus), prior=doc)
+    assert [e is o for e, o in zip(doc2["entries"], doc["entries"])] == [True, True]
+
+
+def test_index_records_unreadable_ledger_as_error_entry(corpus):
+    bad = corpus / "bad.jsonl"
+    bad.write_text(
+        '{"kind": "header", "version": 1, "config": {}}\nnot json\nalso not\n'
+    )
+    doc = cindex.index_corpus(str(corpus))
+    errored = [e for e in doc["entries"] if e.get("error")]
+    assert len(errored) == 1 and errored[0]["path"].endswith("bad.jsonl")
+    rc, _out = run_cli(["corpus", "index", str(corpus)])
+    assert rc == 1  # the indexing operator sees red; resolution skips
+
+
+def test_read_index_tolerates_garbage(tmp_path):
+    assert cindex.read_index(str(tmp_path)) is None
+    (tmp_path / cindex.INDEX_NAME).write_text("{torn")
+    assert cindex.read_index(str(tmp_path)) is None
+    # valid JSON with a non-coercible version: same rebuild-don't-crash
+    (tmp_path / cindex.INDEX_NAME).write_text('{"entries": [], "version": null}')
+    assert cindex.read_index(str(tmp_path)) is None
+
+
+# -- resolution ------------------------------------------------------------
+
+
+def test_resolve_exact_plus_fuzzy_with_down_weighting(corpus):
+    res = resolve(live_space(), str(corpus), workload="quadratic")
+    kinds = {s["match"] for s in res.sources}
+    assert kinds == {"exact", "fuzzy"}
+    exact_n = sum(s["records"] for s in res.sources if s["match"] == "exact")
+    assert exact_n == 6
+    fuzzy_obs = [o for o in res.observations if o.budget == 0]
+    exact_obs = [o for o in res.observations if o.budget != 0]
+    assert len(exact_obs) == 6 and len(fuzzy_obs) == 2
+    assert res.skips.get("fuzzy_dropped") == 2
+
+
+def test_resolve_dedups_exact_duplicates_newest_wins(tmp_path):
+    c = tmp_path / "corpus"
+    c.mkdir()
+    space = live_space()
+    p = {"lr": 0.1, "reg": 0.3}
+    fabricate_ledger(c / "old.jsonl", space, [(p, 0.1, 3)])
+    fabricate_ledger(c / "new.jsonl", space, [(p, 0.9, 3)])
+    res = resolve(space, str(c))
+    assert len(res.observations) == 1  # one point, not two
+    assert res.observations[0].score == pytest.approx(0.9)  # newest ts won
+    assert res.skips.get("duplicate_params") == 1
+
+
+def test_resolve_keeps_same_point_at_different_budgets(tmp_path):
+    """The budget is part of evaluation identity (EvalCache's
+    both-keys-survive rule): one point journaled at two budgets merges
+    as TWO observations, so multi-rung corpora lose no low-rung
+    evidence to the dedup."""
+    c = tmp_path / "corpus"
+    c.mkdir()
+    space = live_space()
+    p = {"lr": 0.1, "reg": 0.3}
+    fabricate_ledger(c / "asha.jsonl", space, [(p, 0.4, 10), (p, 0.9, 270)])
+    res = resolve(space, str(c))
+    assert sorted((o.budget, o.score) for o in res.observations) == [
+        (10, 0.4),
+        (270, 0.9),
+    ]
+    assert "duplicate_params" not in res.skips
+
+
+def test_resolve_excludes_own_ledger(corpus):
+    res = resolve(
+        live_space(),
+        str(corpus),
+        workload="quadratic",
+        exclude=str(corpus / "exact.jsonl"),
+    )
+    assert all(s["match"] == "fuzzy" for s in res.sources)
+
+
+def test_resolve_stale_and_corrupt_entries_degrade_to_skips(corpus):
+    cindex.index_corpus(str(corpus))
+    os.unlink(corpus / "fuzzy.jsonl")  # deleted behind the index
+    events = []
+
+    class Spy:
+        def log(self, event, **f):
+            events.append((event, f))
+
+    res = resolve(live_space(), str(corpus), workload="quadratic", metrics=Spy())
+    assert [s["match"] for s in res.sources] == ["exact"]
+    assert len(res.skipped) == 1 and "deleted" in res.skipped[0]["reason"]
+    assert events and events[0][0] == "corpus_skip"
+    # a CORRUPT index file degrades to a rebuild + skip, never a crash
+    with open(cindex.index_path(str(corpus)), "w") as f:  # sweeplint: disable=corpus-index-write -- the test FORGES the torn-index failure shape the checker exists to prevent
+        f.write("{half a docu")
+    res2 = resolve(live_space(), str(corpus), workload="quadratic")
+    assert [s["match"] for s in res2.sources] == ["exact"]
+    assert any("index-unreadable" in sk["reason"] for sk in res2.skipped)
+
+
+def test_resolve_changed_ledger_is_resummarized_live(corpus):
+    space = live_space()
+    cindex.index_corpus(str(corpus))
+    # the exact ledger GROWS after indexing: resolution re-reads it
+    led = SweepLedger(str(corpus / "exact.jsonl"))
+    led.record_trial(
+        TrialResult(trial_id=99, score=123.0, step=3, wall_time=0.0),
+        space.canonical_params({"lr": 0.5, "reg": 0.5}),
+    )
+    led.close()
+    res = resolve(space, str(corpus))
+    assert max(o.score for o in res.observations) == pytest.approx(123.0)
+
+
+# -- the acceptance drill: --warm-start auto: ------------------------------
+
+
+def test_auto_warm_start_matches_manual_and_names_sources(corpus, tmp_path):
+    rc, _ = sweep(
+        tmp_path / "auto.jsonl",
+        seed=7,
+        trials=5,
+        warm=f"auto:{corpus}",
+        metrics=tmp_path / "m.jsonl",
+    )
+    assert rc == 0
+    rc, _ = sweep(
+        tmp_path / "manual.jsonl", seed=7, trials=5, warm=corpus / "exact.jsonl"
+    )
+    assert rc == 0
+    keep = ("trial_id", "params", "status", "score", "step")
+
+    def records(p):
+        return [
+            {k: r[k] for k in keep}
+            for r in map(json.loads, open(p).read().splitlines()[1:])
+        ]
+
+    assert records(tmp_path / "auto.jsonl") == records(tmp_path / "manual.jsonl")
+    ws = [
+        json.loads(line)
+        for line in open(tmp_path / "m.jsonl")
+        if '"warm_start"' in line
+    ]
+    assert len(ws) == 1
+    sources = {s["match"]: s for s in ws[0]["sources"]}
+    assert sources["exact"]["path"].endswith("exact.jsonl")
+    assert sources["fuzzy"]["path"].endswith("fuzzy.jsonl")
+
+
+def test_auto_warm_start_usage_errors(tmp_path):
+    with pytest.raises(SystemExit) as e:
+        run_cli(
+            ["--workload", "quadratic", "--trials", "2", "--workers", "1",
+             "--warm-start", "auto"]
+        )
+    assert e.value.code == 2
+    with pytest.raises(SystemExit) as e:
+        run_cli(
+            ["--workload", "quadratic", "--trials", "2", "--workers", "1",
+             "--warm-start", f"auto:{tmp_path}/nope"]
+        )
+    assert e.value.code == 2
+
+
+def test_self_warm_start_guard_covers_fused_path(tmp_path):
+    """The realpath guard now lives in the SHARED resolver: the fused
+    path refuses self-feeding too (ISSUE 14 satellite)."""
+    led = tmp_path / "sweep.jsonl"
+    with pytest.raises(SystemExit) as e:
+        run_cli(
+            ["--workload", "fashion_mlp", "--algorithm", "tpe", "--fused",
+             "--no-mesh", "--trials", "2", "--population", "2",
+             "--ledger", str(led), "--warm-start", str(tmp_path / "." / "sweep.jsonl")]
+        )
+    assert e.value.code == 2
+
+
+def test_corpus_resolve_cli_dry_run(corpus):
+    rc, out = run_cli(
+        ["corpus", "resolve", str(corpus), "--workload", "quadratic", "--json"]
+    )
+    assert rc == 0
+    rep = json.loads(out)
+    assert rep["observations"] == 8
+    assert {s["match"] for s in rep["sources"]} == {"exact", "fuzzy"}
+
+
+# -- CorpusCache -----------------------------------------------------------
+
+
+def test_corpus_cache_exact_semantics_unchanged_prior_separate():
+    space = live_space()
+    cache = CorpusCache(space)
+    params = space.canonical_params({"lr": 0.1, "reg": 0.3})
+    cache.seed_from([{"status": "ok", "score": 0.4, "step": 10, "params": params}])
+    cache.seed_prior([{"status": "ok", "score": 0.4, "step": 10, "params": params}])
+    # exact: byte-identical to EvalCache — budget is part of the key
+    hit = cache.get(params, 10, trial_id=1)
+    assert hit is not None and hit.extra["cache_hit"] is True
+    assert cache.get(params, 270, trial_id=2) is None
+    # prior: the SAME point at a different budget serves as evidence
+    prior = cache.get_prior(params, trial_id=3)
+    assert prior.extra == {"fidelity": "prior", "prior_kind": "budget"}
+    assert prior.score == pytest.approx(0.4) and prior.step == 10
+    assert cache.prior_hits == 1
+    # unseen point: no prior
+    other = space.canonical_params({"lr": 2.0, "reg": 0.9})
+    assert cache.get_prior(other, trial_id=4) is None
+
+
+def test_corpus_cache_prior_prefers_same_space_and_higher_budget():
+    space = live_space()
+    cache = CorpusCache(space)
+    params = space.canonical_params({"lr": 0.1, "reg": 0.3})
+    cache.seed_prior(
+        [{"status": "ok", "score": 0.2, "step": 10, "params": params}], fuzzy=True
+    )
+    assert cache.get_prior(params, 0).extra["prior_kind"] == "fuzzy"
+    # same-space evidence displaces fuzzy...
+    cache.seed_prior([{"status": "ok", "score": 0.5, "step": 10, "params": params}])
+    assert cache.get_prior(params, 0).extra["prior_kind"] == "budget"
+    # ...fuzzy can never displace it back
+    cache.seed_prior(
+        [{"status": "ok", "score": 0.9, "step": 99, "params": params}], fuzzy=True
+    )
+    p = cache.get_prior(params, 0)
+    assert p.extra["prior_kind"] == "budget" and p.score == pytest.approx(0.5)
+    # higher-budget same-space evidence wins over lower
+    cache.seed_prior([{"status": "ok", "score": 0.7, "step": 270, "params": params}])
+    assert cache.get_prior(params, 0).step == 270
+
+
+# -- suggestion service ----------------------------------------------------
+
+
+def serve_in_thread(server, sdir, ledger=None, idle_timeout=10.0):
+    from mpi_opt_tpu.utils.metrics import null_logger
+
+    out = {}
+
+    def run():
+        from mpi_opt_tpu.corpus.serve import serve_loop
+
+        out.update(
+            serve_loop(
+                server,
+                str(sdir),
+                null_logger(),
+                ledger=ledger,
+                poll_seconds=0.01,
+                idle_timeout=idle_timeout,
+            )
+        )
+
+    th = threading.Thread(target=run)
+    th.start()
+    return th, out
+
+
+def test_suggest_server_round_trip_lookup_and_resume(tmp_path):
+    from mpi_opt_tpu.corpus import client
+    from mpi_opt_tpu.corpus.serve import SuggestServer
+
+    space = live_space()
+    led = SweepLedger(str(tmp_path / "suggest.jsonl"))
+    led.ensure_header(
+        {"mode": "suggest", "algorithm": "tpe", "workload": "quadratic",
+         "backend": "suggest", "seed": 0, "space_hash": space.space_hash()},
+        space_spec=space.spec(),
+    )
+    server = SuggestServer(space, seed=0)
+    th, summary = serve_in_thread(server, tmp_path / "sugg", ledger=led)
+    try:
+        ans = client.round_trip(str(tmp_path / "sugg"), {"op": "suggest", "n": 3})
+        assert len(ans["params"]) == 3 and len(ans["units"]) == 3
+        for p in ans["params"]:
+            r = client.round_trip(
+                str(tmp_path / "sugg"),
+                {"op": "report", "params": p, "score": 0.5, "budget": 1},
+            )
+            assert r["ok"] is True
+        # lookup: exact at the reported budget, prior at any other
+        lk = client.round_trip(
+            str(tmp_path / "sugg"),
+            {"op": "lookup", "params": ans["params"][0], "budget": 1},
+        )
+        assert lk["hit"] == "exact"
+        lk2 = client.round_trip(
+            str(tmp_path / "sugg"),
+            {"op": "lookup", "params": ans["params"][0], "budget": 99},
+        )
+        assert lk2["hit"] == "prior" and lk2["fidelity"] == "prior"
+        # malformed ops are answered, never crash the server
+        bad = client.round_trip(str(tmp_path / "sugg"), {"op": "nope"})
+        assert "error" in bad
+    finally:
+        client.request_stop(str(tmp_path / "sugg"))
+        th.join(timeout=30)
+    assert not th.is_alive()
+    assert summary["stopped"] and summary["reports"] == 3
+    led.close()
+    # resume: the ring and the report serial rebuild from the journal
+    led2 = SweepLedger(str(tmp_path / "suggest.jsonl"))
+    from mpi_opt_tpu.corpus.serve import SuggestServer as S2
+
+    fresh = S2(space, seed=0)
+    assert fresh.seed_from_ledger(led2.records) == 3
+    assert fresh._next_id == 3
+    led2.close()
+
+
+def test_suggest_stop_drains_pending_and_consumes_flag(tmp_path):
+    """The stop flag means 'finish what is queued, then exit': a
+    request already on the spool when stop lands is still answered,
+    and the consumed flag cannot instantly stop the NEXT server."""
+    from mpi_opt_tpu.corpus import client
+    from mpi_opt_tpu.corpus.serve import (
+        SuggestServer,
+        ensure_spool,
+        serve_loop,
+        stop_path,
+    )
+    from mpi_opt_tpu.utils.metrics import null_logger
+
+    sdir = str(tmp_path / "sugg")
+    ensure_spool(sdir)
+    rid = client.request(sdir, {"op": "suggest", "n": 2})  # queued first
+    client.request_stop(sdir)  # ...then stop, before any server runs
+    server = SuggestServer(live_space(), seed=0)
+    summary = serve_loop(server, sdir, null_logger(), poll_seconds=0.01)
+    assert summary["stopped"] and summary["served"] == 1
+    ans = client.wait_response(sdir, rid, timeout=5)
+    assert ans is not None and len(ans["params"]) == 2  # answered, not dropped
+    assert not os.path.exists(stop_path(sdir))  # flag consumed
+
+
+def test_sweep_responses_expires_only_stale_files(tmp_path):
+    from mpi_opt_tpu.corpus.serve import _sweep_responses
+
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text("{}")
+    new.write_text("{}")
+    past = time.time() - 3600
+    os.utime(old, (past, past))
+    _sweep_responses(str(tmp_path), ttl_s=600)
+    assert not old.exists() and new.exists()
+
+
+def test_suggest_reports_journal_as_corpus_material(tmp_path):
+    """A suggestion tenant's ledger is itself corpus material: its
+    journaled reports index and resolve like any sweep's."""
+    from mpi_opt_tpu.corpus.serve import SuggestServer
+
+    space = live_space()
+    c = tmp_path / "corpus"
+    c.mkdir()
+    led = SweepLedger(str(c / "suggest.jsonl"))
+    led.ensure_header(
+        {"mode": "suggest", "algorithm": "tpe", "workload": "quadratic",
+         "backend": "suggest", "seed": 0, "space_hash": space.space_hash()},
+        space_spec=space.spec(),
+    )
+    server = SuggestServer(space, seed=0)
+    got = server.suggest(2)
+    for p in got["params"]:
+        server.report({"params": p, "score": 0.25, "budget": 2}, ledger=led)
+    led.close()
+    doc = cindex.index_corpus(str(c))
+    assert doc["entries"][0]["ok"] == 2
+    res = resolve(space, str(c), workload="quadratic")
+    assert len(res.observations) == 2
+
+
+def test_suggest_acquisition_engages_after_startup(tmp_path):
+    """Past n_startup reports the served suggestions come from the
+    acquisition kernel (differ from the cold uniform stream)."""
+    from mpi_opt_tpu.corpus.serve import SuggestServer
+
+    space = live_space()
+    cold = SuggestServer(space, seed=3, n_startup=4)
+    warm = SuggestServer(space, seed=3, n_startup=4)
+    warm.ingest(
+        [
+            Observation(unit=np.full(2, 0.3, np.float32), score=float(s), budget=1)
+            for s in range(6)
+        ]
+    )
+    cold_units = np.asarray(cold.suggest(4)["units"])
+    warm_units = np.asarray(warm.suggest(4)["units"])
+    assert not np.allclose(cold_units, warm_units)
+
+
+def test_suggest_tenant_parks_and_resumes_across_slices(tmp_path):
+    """A suggestion tenant outliving its slice budget PARKS (exit 75)
+    and the next slice's --resume rebuilds the ring from its journal:
+    every report lands exactly once, the serial never aliases across
+    slices, and the tenant still completes via its idle timeout."""
+    from mpi_opt_tpu.corpus import client
+    from mpi_opt_tpu.service.scheduler import SweepService
+    from mpi_opt_tpu.service.spool import Spool
+
+    state = tmp_path / "state"
+    sdir = str(tmp_path / "sugg")
+    spool = Spool(str(state))
+    job = spool.submit(
+        ["--workload", "quadratic", "--suggest-serve", sdir,
+         "--suggest-idle-timeout", "0.4"],
+        tenant="ext",
+    )
+    svc = SweepService(
+        str(state), slice_boundaries=3, poll_seconds=0.02, drain_on_empty=True
+    )
+
+    def traffic():
+        for i in range(6):  # more round trips than one slice's budget
+            ans = client.round_trip(sdir, {"op": "suggest", "n": 2}, timeout=60)
+            client.round_trip(
+                sdir,
+                {"op": "report", "params": ans["params"][0],
+                 "score": 0.1 * i, "budget": 1},
+                timeout=60,
+            )
+
+    th = threading.Thread(target=traffic)
+    th.start()
+    rc = svc.serve()
+    th.join(timeout=60)
+    assert rc == 0 and not th.is_alive()
+    st = spool.tenant(job).status
+    assert st["state"] == "done" and st["slices"] >= 2, st
+    recs = [
+        json.loads(line)
+        for line in open(spool.tenant(job).ledger).read().splitlines()[1:]
+    ]
+    ids = [r["trial_id"] for r in recs]
+    assert len(ids) == len(set(ids)) == 6, ids
+
+
+def test_suggest_tenant_completes_under_sweep_service(tmp_path):
+    """The suggestion server IS a schedulable tenant: submitted through
+    the spool, sliced by the resident scheduler, completing (done) via
+    its idle timeout — with its per-tenant ledger journaled."""
+    from mpi_opt_tpu.service.scheduler import SweepService
+    from mpi_opt_tpu.service.spool import Spool
+
+    state = tmp_path / "state"
+    sdir = tmp_path / "sugg"
+    spool = Spool(str(state))
+    job = spool.submit(
+        ["--workload", "quadratic", "--suggest-serve", str(sdir),
+         "--suggest-idle-timeout", "0.2"],
+        tenant="ext",
+    )
+    svc = SweepService(
+        str(state), slice_boundaries=100, poll_seconds=0.02, drain_on_empty=True
+    )
+    rc = svc.serve()
+    assert rc == 0
+    t = spool.tenant(job)
+    assert t.status["state"] == "done"
+    header = json.loads(open(t.ledger).read().splitlines()[0])
+    assert header["config"]["mode"] == "suggest"

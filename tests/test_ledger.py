@@ -146,7 +146,8 @@ def test_warm_start_decodes_exotic_choice_options(tmp_path):
     led.ensure_header({"space_hash": space.space_hash()})
     led.record_trial(_ok(0, 2.0), space.canonical_params({"k": (3, 4), "u": 0.5}))
     led.close()
-    (obs,) = load_observations(led.path, space)
+    (obs,), skips = load_observations(led.path, space)
+    assert skips == {}
     assert obs.score == 2.0
     # the decoded unit row round-trips to the original option
     assert space.materialize_row(obs.unit)["k"] == (3, 4)
@@ -193,6 +194,29 @@ def test_cache_hits_only_exact_params_and_budget():
     assert cache.get(params, 40, 9) is None  # other budget: other computation
     # internal driver keys never change the identity
     assert cache.get({**params, "__inherit_from__": 3}, 20, 10) is not None
+
+
+def test_cache_seed_from_duplicate_params_at_different_budgets():
+    """The budget is part of the key: one point evaluated at two
+    budgets (an ASHA trial at rungs 10 and 270) seeds TWO memo entries,
+    and each budget's hit serves its own recorded score (ISSUE 14
+    satellite: the both-keys-survive contract gets direct coverage)."""
+    space = get_workload("quadratic").default_space()
+    cache = EvalCache(space)
+    params = space.canonical_params({"lr": 0.1, "reg": 0.3})
+    assert (
+        cache.seed_from(
+            [
+                {"status": "ok", "score": 0.4, "step": 10, "params": params},
+                {"status": "ok", "score": 0.9, "step": 270, "params": params},
+            ]
+        )
+        == 2
+    )
+    assert len(cache) == 2
+    assert cache.get(params, 10, 1).score == pytest.approx(0.4)
+    assert cache.get(params, 270, 2).score == pytest.approx(0.9)
+    assert cache.get(params, 100, 3) is None  # un-seen budget: miss
 
 
 def test_cache_never_caches_failures():
@@ -403,6 +427,48 @@ def test_warm_start_refuses_other_space(tmp_path):
     algo = RandomSearch(other, seed=0, max_trials=4)
     with pytest.raises(LedgerError, match="space hash"):
         warm_start(algo, path)
+
+
+def test_warm_start_counts_undecodable_choice_as_skip(tmp_path):
+    """A hash-matched ledger holding one record whose Choice value no
+    live option canonicalizes to loses THAT record (counted in skips)
+    instead of refusing the whole prior (ISSUE 14 satellite)."""
+    from mpi_opt_tpu.ledger.warmstart import load_observations
+    from mpi_opt_tpu.space import Choice, SearchSpace, Uniform
+
+    space = SearchSpace({"k": Choice(["a", "b"]), "u": Uniform(0.0, 1.0)})
+    led = SweepLedger(str(tmp_path / "prior.jsonl"))
+    led.ensure_header({"space_hash": space.space_hash()})
+    led.record_trial(_ok(0, 1.0), space.canonical_params({"k": "a", "u": 0.5}))
+    led.record_trial(_ok(1, 2.0), {"k": "zzz", "u": 0.5})  # no such option
+    led.record_trial(failed_result(2, step=20, error="boom"), {"k": "b", "u": 0.1})
+    led.close()
+    obs, skips = load_observations(led.path, space)
+    assert len(obs) == 1 and obs[0].score == 1.0
+    assert skips == {"not_ok": 1, "bad_choice": 1}
+
+
+def test_best_observation_nonfinite_guard():
+    """Non-finite priors never seed a sweep: NaN cannot win (x > nan is
+    False), +inf must not win, and an all-diverged prior seeds nothing
+    (ISSUE 14 satellite: the guard gets direct coverage)."""
+    from mpi_opt_tpu.ledger.warmstart import best_observation
+
+    unit = np.zeros(2, dtype=np.float32)
+    mixed = [
+        Observation(unit=unit, score=float("nan")),
+        Observation(unit=unit, score=0.7),
+        Observation(unit=unit, score=float("inf")),
+        Observation(unit=unit, score=0.9),
+        Observation(unit=unit, score=float("-inf")),
+    ]
+    assert best_observation(mixed).score == pytest.approx(0.9)
+    diverged = [
+        Observation(unit=unit, score=float("nan")),
+        Observation(unit=unit, score=float("inf")),
+    ]
+    assert best_observation(diverged) is None
+    assert best_observation([]) is None
 
 
 # -- space identity --------------------------------------------------------

@@ -241,8 +241,9 @@ def test_cross_mode_warm_start_fused_to_driver(tmp_path, space):
     u = _units(3, space)
     j.record_boundary(0, [0, 1, 2], u, [0.5, float("nan"), 0.7], step=5)
     led.close()
-    obs = load_observations(led.path, space)
+    obs, skips = load_observations(led.path, space)
     assert len(obs) == 2  # failed member never becomes an observation
+    assert skips == {"not_ok": 1}  # ...and the loss is COUNTED, not silent
     assert best_observation(obs).score == pytest.approx(0.7)
     # params round-trip: the best observation's unit decodes back to
     # (approximately) the journaled member's unit row
@@ -259,7 +260,7 @@ def test_cross_mode_warm_start_refused_only_on_space_hash(tmp_path, space):
         0, [0], _units(1, space), [0.5], step=5
     )
     led.close()
-    assert len(load_observations(led.path, space)) == 1  # mode never refuses
+    assert len(load_observations(led.path, space)[0]) == 1  # mode never refuses
     # forge a different space hash into the header
     lines = open(led.path).read().splitlines()
     hdr = json.loads(lines[0])
