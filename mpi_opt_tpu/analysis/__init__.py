@@ -40,6 +40,13 @@ from mpi_opt_tpu.analysis.core import (  # noqa: F401
 def all_checkers():
     """One fresh instance of every registered checker (stateless between
     files by contract; a fresh set per run keeps that honest)."""
+    from mpi_opt_tpu.analysis.checkers_concurrency import (
+        BeatPathChecker,
+        FsyncBeforeRenameChecker,
+        GuardedByChecker,
+        LockOrderChecker,
+        SignalSafetyChecker,
+    )
     from mpi_opt_tpu.analysis.checkers_corpus import CorpusIndexWriteChecker
     from mpi_opt_tpu.analysis.checkers_drain import DrainSwallowChecker
     from mpi_opt_tpu.analysis.checkers_durability import (
@@ -67,4 +74,11 @@ def all_checkers():
         LeaseWriteChecker(),
         CorpusIndexWriteChecker(),
         ResourceFunnelChecker(),
+        FsyncBeforeRenameChecker(),
+        # project-pass checkers (racelint, ISSUE 15): run over the
+        # repo-wide symbol table after every file is parsed
+        GuardedByChecker(),
+        BeatPathChecker(),
+        SignalSafetyChecker(),
+        LockOrderChecker(),
     ]

@@ -11,7 +11,18 @@ one stable top-level object a CI gate can parse::
      "findings": [{"check", "file", "line", "severity", "message",
                    "hint"}, ...],
      "baselined": [...same shape...], "errors": [str, ...],
-     "checks": [{"id", "severity", "hint"}, ...]}
+     "checks": [{"id", "severity", "hint", "wall_s"}, ...],
+     "project": {"locks": [...], "thread_entries": [...],
+                 "signal_handlers": [...], "beat_entries": [...],
+                 "lock_order": {"edges": [...], "cycles": [...]}}}
+
+``wall_s`` is each checker's attributed wall time (the full-repo
+self-lint budgets <15 s total; per-checker attribution makes a future
+slow checker a number instead of a mystery) — the synthetic
+``project-table`` entry carries the pass-1 symbol-table build + link
+time, which belongs to no single checker — and ``project`` is the
+racelint pass-1 digest (ISSUE 15), null when the run carried no
+project checkers.
 
 ``--write-baseline FILE`` records the CURRENT findings as accepted —
 the adoption workflow: run it once on a legacy tree, commit the file,
@@ -32,7 +43,7 @@ import sys
 from mpi_opt_tpu.analysis import all_checkers
 from mpi_opt_tpu.analysis.core import (
     load_baseline,
-    run_paths,
+    run_paths_ex,
     split_baselined,
     write_baseline,
 )
@@ -94,7 +105,7 @@ def lint_main(argv=None) -> int:
             p.error(f"--baseline: {e}")
 
     checkers = all_checkers()
-    findings, n_files, errors = run_paths(paths, checkers)
+    findings, n_files, errors, table = run_paths_ex(paths, checkers)
     fresh, accepted = split_baselined(findings, baseline, root)
 
     if args.write_baseline is not None:
@@ -121,6 +132,8 @@ def lint_main(argv=None) -> int:
 
     ok = not fresh and not errors
     if args.json:
+        from mpi_opt_tpu.analysis import project as project_mod
+
         print(
             json.dumps(
                 {
@@ -131,9 +144,35 @@ def lint_main(argv=None) -> int:
                     "baselined": [f.as_dict(root) for f in accepted],
                     "errors": errors,
                     "checks": [
-                        {"id": c.id, "severity": c.severity, "hint": c.hint}
+                        {
+                            "id": c.id,
+                            "severity": c.severity,
+                            "hint": c.hint,
+                            "wall_s": round(c.wall_s, 4),
+                        }
                         for c in checkers
-                    ],
+                    ]
+                    + (
+                        # the symbol-table build is the project pass's
+                        # dominant cost and belongs to no one checker;
+                        # a synthetic entry keeps wall attribution
+                        # honest (a slow build must be a number too)
+                        [
+                            {
+                                "id": "project-table",
+                                "severity": "info",
+                                "hint": "racelint pass-1 symbol-table "
+                                "build + call-graph link (shared by "
+                                "all project checkers)",
+                                "wall_s": round(table.build_wall_s, 4),
+                            }
+                        ]
+                        if table is not None
+                        else []
+                    ),
+                    "project": (
+                        None if table is None else project_mod.summary(table, root)
+                    ),
                 }
             )
         )

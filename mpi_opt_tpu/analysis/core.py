@@ -15,7 +15,20 @@ parsed once per file into :class:`FileContext`:
 - ``# sweeplint: disable=<id>[,<id>] -- reason`` on the finding line or
   the line directly above suppresses those checks there;
 - ``# sweeplint: barrier(reason)`` on a ``def`` line marks the function
-  as an explicit host-sync barrier (checkers_jax.HostSyncChecker).
+  as an explicit host-sync barrier (checkers_jax.HostSyncChecker);
+- ``# sweeplint: guarded-by(<lock>)`` on a module global's declaration
+  line declares which lock its shared writers must hold
+  (checkers_concurrency.GuardedByChecker).
+
+Two checker shapes share the framework (ISSUE 15): per-file
+:class:`Checker` subclasses ride the single walk above, and
+:class:`ProjectChecker` subclasses run a SECOND pass over the repo-wide
+symbol table (mpi_opt_tpu/analysis/project.py) after every file has
+been parsed — cross-file properties (thread-entry reachability, the
+lock partial order) cannot be judged one file at a time. Both report
+:class:`Finding` through the same suppression/baseline machinery, and
+the framework charges wall time per checker (``Checker.wall_s``) so a
+future slow checker is diagnosable from ``lint --json``.
 
 The baseline is a committed JSON file of accepted legacy findings,
 keyed by (check, relpath, stripped line content) — content, not line
@@ -29,6 +42,7 @@ import ast
 import json
 import os
 import re
+import time
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
@@ -37,8 +51,9 @@ from typing import Iterable, Optional
 #: drill scripts with deliberate kill shapes)
 EXCLUDED_DIRS = ("__pycache__", ".git", "tests", "probes", "node_modules")
 
-_DIRECTIVE = re.compile(r"#\s*sweeplint:\s*(disable|barrier)\b([^#\n]*)")
+_DIRECTIVE = re.compile(r"#\s*sweeplint:\s*(disable|barrier|guarded-by)\b([^#\n]*)")
 _DISABLE_IDS = re.compile(r"disable\s*=\s*([\w,\-]+)")
+_GUARDED_BY = re.compile(r"guarded-by\s*\(\s*([\w.]+)\s*\)")
 
 
 @dataclass
@@ -94,6 +109,10 @@ class FileContext:
     disabled: dict = field(default_factory=dict)
     #: linenos carrying a `# sweeplint: barrier` annotation
     barriers: set = field(default_factory=set)
+    #: lineno -> lock name from a `# sweeplint: guarded-by(<lock>)`
+    #: annotation (the guarded-by checker honors the declaration line
+    #: or the line directly above, like suppression)
+    guards: dict = field(default_factory=dict)
 
     @classmethod
     def parse(cls, path: str, source: str) -> "FileContext":
@@ -105,6 +124,10 @@ class FileContext:
                 continue
             if m.group(1) == "barrier":
                 ctx.barriers.add(i)
+            elif m.group(1) == "guarded-by":
+                g = _GUARDED_BY.search(m.group(0))
+                if g:
+                    ctx.guards[i] = g.group(1)
             else:
                 ids = _DISABLE_IDS.search(m.group(0))
                 if ids:
@@ -112,6 +135,13 @@ class FileContext:
                         s for s in ids.group(1).split(",") if s
                     )
         return ctx
+
+    def guard_for(self, lineno: int) -> Optional[str]:
+        """The guarded-by lock declared on ``lineno`` or the line above."""
+        for ln in (lineno, lineno - 1):
+            if ln in self.guards:
+                return self.guards[ln]
+        return None
 
     def line_text(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
@@ -142,6 +172,11 @@ class Checker:
 
     def __init__(self):
         self.findings: list = []
+        #: cumulative seconds this checker spent across the run (begin/
+        #: visit/finish for per-file checkers, check_project for project
+        #: ones) — surfaced in `lint --json` so a slow checker is a
+        #: number, not a mystery
+        self.wall_s: float = 0.0
 
     # -- hooks ------------------------------------------------------------
 
@@ -179,15 +214,33 @@ class Checker:
         )
 
 
+class ProjectChecker(Checker):
+    """Base for two-pass checkers: ``check_project`` runs once over the
+    repo-wide symbol table (analysis/project.py ProjectTable) after
+    every file has been parsed. Project checkers take no part in the
+    per-file walk (``interested`` is False); their findings flow through
+    the same suppression and baseline machinery via the table's parsed
+    FileContexts."""
+
+    def interested(self, ctx: FileContext) -> bool:
+        return False
+
+    def check_project(self, table) -> None:
+        raise NotImplementedError
+
+
 def check_file_context(ctx: FileContext, checkers: Iterable[Checker]) -> list:
     """Run ``checkers`` over one parsed file: single walk, type-dispatched,
     suppression applied. Returns surviving findings."""
     active = [c for c in checkers if c.interested(ctx)]
     if not active:
         return []
+    clock = time.perf_counter
     for c in active:
         c.findings = []
+        t0 = clock()
         c.begin_file(ctx)
+        c.wall_s += clock() - t0
     dispatch: dict = {}
     for c in active:
         for t in c.interests:
@@ -195,12 +248,43 @@ def check_file_context(ctx: FileContext, checkers: Iterable[Checker]) -> list:
     if dispatch:
         for node in ast.walk(ctx.tree):
             for c in dispatch.get(type(node), ()):
+                t0 = clock()
                 c.visit(node, ctx)
+                c.wall_s += clock() - t0
     out: list = []
     for c in active:
+        t0 = clock()
         c.finish_file(ctx)
+        c.wall_s += clock() - t0
         out.extend(f for f in c.findings if not ctx.suppressed(f))
     return out
+
+
+def run_project_checkers(ctxs: dict, checkers: Iterable["ProjectChecker"]) -> tuple:
+    """The second pass: build the symbol table over every parsed file
+    and run the project checkers against it. Returns
+    ``(findings, table)`` — findings suppressed through each file's own
+    directives, exactly like the per-file pass."""
+    from mpi_opt_tpu.analysis.project import build_table
+
+    checkers = list(checkers)
+    if not checkers:
+        return [], None
+    t0 = time.perf_counter()
+    table = build_table(list(ctxs.values()))
+    table.build_wall_s = time.perf_counter() - t0
+    out: list = []
+    for c in checkers:
+        c.findings = []
+        t0 = time.perf_counter()
+        c.check_project(table)
+        c.wall_s += time.perf_counter() - t0
+        for f in c.findings:
+            ctx = ctxs.get(f.file)
+            if ctx is not None and ctx.suppressed(f):
+                continue
+            out.append(f)
+    return out, table
 
 
 def check_source(
@@ -214,7 +298,16 @@ def check_source(
         from mpi_opt_tpu.analysis import all_checkers
 
         checkers = all_checkers()
-    return check_file_context(FileContext.parse(path, source), checkers)
+    checkers = list(checkers)
+    ctx = FileContext.parse(path, source)
+    findings = check_file_context(
+        ctx, [c for c in checkers if not isinstance(c, ProjectChecker)]
+    )
+    project = [c for c in checkers if isinstance(c, ProjectChecker)]
+    if project:
+        pf, _table = run_project_checkers({path: ctx}, project)
+        findings = sorted(findings + pf, key=lambda f: (f.file, f.line, f.check))
+    return findings
 
 
 def iter_python_files(root: str):
@@ -235,17 +328,33 @@ def run_paths(
     paths: Iterable[str], checkers: Optional[Iterable[Checker]] = None
 ) -> tuple:
     """Lint every python file under ``paths``. Returns
-    ``(findings, n_files, errors)`` where ``errors`` are files that
-    could not be read/parsed (reported, never silently skipped — a
+    ``(findings, n_files, errors)`` — see :func:`run_paths_ex` for the
+    variant that also returns the project symbol table."""
+    findings, n_files, errors, _table = run_paths_ex(paths, checkers)
+    return findings, n_files, errors
+
+
+def run_paths_ex(
+    paths: Iterable[str], checkers: Optional[Iterable[Checker]] = None
+) -> tuple:
+    """Two-pass lint over every python file under ``paths``: per-file
+    checkers ride one walk per file; project checkers then run over the
+    repo-wide symbol table built from the same parse. Returns
+    ``(findings, n_files, errors, table)`` where ``errors`` are files
+    that could not be read/parsed (reported, never silently skipped — a
     syntax-broken file would otherwise make the lint vacuously green
-    exactly when the tree is at its sickest)."""
+    exactly when the tree is at its sickest) and ``table`` is the
+    ProjectTable (None when no project checkers ran)."""
     if checkers is None:
         from mpi_opt_tpu.analysis import all_checkers
 
         checkers = all_checkers()
     checkers = list(checkers)
+    file_checkers = [c for c in checkers if not isinstance(c, ProjectChecker)]
+    project_checkers = [c for c in checkers if isinstance(c, ProjectChecker)]
     findings: list = []
     errors: list = []
+    ctxs: dict = {}
     n_files = 0
     for root in paths:
         for path in iter_python_files(root):
@@ -257,9 +366,14 @@ def run_paths(
             except (OSError, SyntaxError, ValueError) as e:
                 errors.append(f"{path}: {type(e).__name__}: {e}")
                 continue
-            findings.extend(check_file_context(ctx, checkers))
+            ctxs[path] = ctx
+            findings.extend(check_file_context(ctx, file_checkers))
+    table = None
+    if project_checkers:
+        pf, table = run_project_checkers(ctxs, project_checkers)
+        findings.extend(pf)
     findings.sort(key=lambda f: (f.file, f.line, f.check))
-    return findings, n_files, errors
+    return findings, n_files, errors, table
 
 
 # -- baseline ------------------------------------------------------------

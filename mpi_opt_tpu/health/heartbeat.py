@@ -53,6 +53,13 @@ class Heartbeat:
 
         from mpi_opt_tpu.obs import trace
 
+        # blocking ON PURPOSE (racelint beat-path-nonblocking judges
+        # this path): the critical section is one integer increment —
+        # nanoseconds, no I/O — and a non-blocking skip would lose
+        # beats, breaking the counter's monotonic contract the stall
+        # watchdog reads. The PR 12 lesson targets locks HELD ACROSS
+        # I/O on this path (the Refresher's file round-trip), not this.
+        # sweeplint: disable=beat-path-nonblocking -- counter-only critical section (no I/O under the lock); skipping would break beat monotonicity
         with self._lock:
             self.beats += 1
             n = self.beats

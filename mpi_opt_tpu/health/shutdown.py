@@ -146,7 +146,11 @@ SLICE = "SLICE"
 
 #: the most recent REAL signal delivered to a guard's handler in this
 #: process (None until one arrives); survives guard exit so a scheduler
-#: can distinguish "my slice expired" from "the platform killed us"
+#: can distinguish "my slice expired" from "the platform killed us".
+#: Written from the signal handler, so it MUST stay a bare GIL-atomic
+#: store: a handler that takes a lock can interrupt that lock's own
+#: holder on the same thread and self-deadlock (the signal-safety rule)
+# sweeplint: disable=guarded-by -- signal handlers may only flag-set; a lock in a handler can self-deadlock against its interrupted holder
 _DELIVERED: Optional[str] = None
 
 #: scheduler-installed per-boundary callback (see set_slice_hook)

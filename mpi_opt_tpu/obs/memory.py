@@ -38,22 +38,29 @@ the per-phase memory column.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 from mpi_opt_tpu.obs import trace
 
 # process-lifetime running peak for the live-array fallback (the real
 # allocator keeps its own peak; this is the best a host-side account
-# can do). Plain int under the GIL — approximate under races, which is
-# fine for a watermark.
-_LIVE_PEAK = 0
+# can do). Samples arrive from the staging transfer thread (stage_out
+# spans note memory) AND the main loop, and the scheduler resets the
+# window per slice — `max()` is a read-modify-write, so a racing pair
+# could lose the larger reading or resurrect a pre-reset peak into the
+# new slice's watermark (racelint guarded-by, ISSUE 15).
+_PEAK_LOCK = threading.Lock()
+_LIVE_PEAK = 0  # sweeplint: guarded-by(_PEAK_LOCK)
 
 
 def reset_peak() -> None:
-    """Drop the live-array fallback's running peak (tests; a bench that
-    measures phases back-to-back wants each phase's own watermark)."""
+    """Drop the live-array fallback's running peak (tests; the service
+    opens a per-slice watermark window; a bench that measures phases
+    back-to-back wants each phase's own watermark)."""
     global _LIVE_PEAK
-    _LIVE_PEAK = 0
+    with _PEAK_LOCK:
+        _LIVE_PEAK = 0
 
 
 def sample(device=None) -> Optional[dict]:
@@ -93,10 +100,12 @@ def sample(device=None) -> Optional[dict]:
             in_use += int(a.nbytes)
         except Exception:  # deleted/donated arrays mid-walk
             pass
-    _LIVE_PEAK = max(_LIVE_PEAK, in_use)
+    with _PEAK_LOCK:
+        _LIVE_PEAK = max(_LIVE_PEAK, in_use)
+        peak = _LIVE_PEAK
     return {
         "bytes_in_use": in_use,
-        "peak_bytes": _LIVE_PEAK,
+        "peak_bytes": peak,
         "bytes_limit": None,
         "source": "live_arrays",
     }

@@ -57,6 +57,10 @@ from mpi_opt_tpu.utils import profiling
 
 _SINK = None  # the MetricsLogger spans emit through (None = disabled)
 _TAGS: dict = {}  # rank/tenant labels stamped into every record
+# warn-once latch, deliberately unlocked: the race window is two
+# threads both observing False and both warning — a duplicate warning,
+# never a lost error; a lock on the emission failure path buys nothing
+# sweeplint: disable=guarded-by -- idempotent warn-once latch: worst race outcome is a duplicate warning
 _WARNED = False
 _LOCAL = threading.local()  # .stack: list[[name, child_dur]]; .tid; .off
 _TID_LOCK = threading.Lock()
@@ -64,7 +68,9 @@ _NEXT_TID = [0]
 # best-effort cross-thread "most recently entered, still active" span
 # name: the heartbeat's fallback when the BEATING thread holds no span
 # (boundary beats happen between spans). Plain assignment — GIL-atomic,
-# approximate under races, which is fine for a diagnostic label.
+# approximate under races, which is fine for a diagnostic label; a lock
+# here would put a contention point inside EVERY span enter/exit.
+# sweeplint: disable=guarded-by -- GIL-atomic store of a best-effort diagnostic label; approximate-under-races is the documented contract
 _LAST_PHASE: Optional[str] = None
 
 

@@ -18,6 +18,7 @@
 #        T1_SKIP_PERFDIFF_DRILL=1 probes/tier1.sh # skip the trace-diff gate drill
 #        T1_SKIP_TIMELINE_DRILL=1 probes/tier1.sh # skip the timeline/bubble drill
 #        T1_SKIP_LINT_DRILL=1 probes/tier1.sh # skip the sweeplint drill
+#        T1_SKIP_RACE_DRILL=1 probes/tier1.sh # skip the racelint/lock-order drill
 #        T1_SKIP_OOM_DRILL=1 probes/tier1.sh # skip the device-OOM backoff drill
 #        T1_SKIP_ENOSPC_DRILL=1 probes/tier1.sh # skip the disk-full drill
 #        T1_SKIP_CORPUS_DRILL=1 probes/tier1.sh # skip the corpus/auto-warm-start drill
@@ -608,6 +609,75 @@ PYEOF
         echo "LINT_DRILL=pass"
     else
         echo "LINT_DRILL=FAIL"
+        rc=$(( rc == 0 ? 1 : rc ))
+    fi
+fi
+
+# -- racelint drill (concurrency contracts, ISSUE 15) --
+# Two halves of one guard-rail. Static: the five concurrency-contract
+# checkers (guarded-by / beat-path-nonblocking / signal-safety /
+# lock-order / fsync-before-rename) run with the whole suite over the
+# repo — ok==true, 0 findings, 0 baselined entries (fix-or-disable
+# policy), >95 files scanned, and the project symbol table actually
+# discovered locks + thread entries (an empty table would be vacuously
+# green). Runtime: a seeded A->B / B->A inversion over tracked locks
+# must trip the lock-order sanitizer through the exact snapshot/leaks
+# path the autouse fixture runs, and a consistent order must not.
+if [ -z "$T1_SKIP_RACE_DRILL" ]; then
+    race_rc=0
+    RJ=$(mktemp /tmp/_t1_race.XXXXXX.json)
+    timeout -k 10 120 python -m mpi_opt_tpu \
+        lint --json --baseline sweeplint-baseline.json >"$RJ" 2>/dev/null \
+        || race_rc=1
+    python - "$RJ" <<'PYEOF' || race_rc=1
+import json, sys
+rep = json.load(open(sys.argv[1]))
+assert rep["ok"] is True, rep["findings"] or rep["errors"]
+assert rep["findings"] == [] and rep["baselined"] == [], rep
+assert rep["files_scanned"] > 95, rep["files_scanned"]
+ids = {c["id"] for c in rep["checks"]}
+for need in ("guarded-by", "beat-path-nonblocking", "signal-safety",
+             "lock-order", "fsync-before-rename"):
+    assert need in ids, sorted(ids)
+proj = rep["project"]
+assert len(proj["locks"]) >= 5, proj["locks"]          # table saw the engine
+assert proj["thread_entries"], proj                     # staging thread found
+assert proj["signal_handlers"], proj                    # ShutdownGuard found
+assert proj["lock_order"]["cycles"] == [], proj["lock_order"]
+PYEOF
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python - <<'PYEOF' >/dev/null 2>&1 || race_rc=1
+import sys
+sys.path.insert(0, "tests")
+import sanitizers
+sanitizers.install_lock_order_tracker()
+a = sanitizers.tracked_lock("drill-a")
+b = sanitizers.tracked_lock("drill-b")
+# seeded inversion: the sanitizer must trip through snapshot/leaks —
+# the same path the autouse fixture judges every tier-1 test by
+before = sanitizers.snapshot()
+with a:
+    with b:
+        pass
+with b:
+    with a:
+        pass
+problems = sanitizers.leaks(before)
+assert any("lock-order inversion" in p for p in problems), problems
+# and a consistent order stays silent in a fresh window
+before = sanitizers.snapshot()
+with a:
+    with b:
+        pass
+with a:
+    with b:
+        pass
+assert sanitizers.leaks(before) == [], sanitizers.leaks(before)
+PYEOF
+    rm -f "$RJ"
+    if [ $race_rc -eq 0 ]; then
+        echo "RACE_DRILL=pass"
+    else
+        echo "RACE_DRILL=FAIL"
         rc=$(( rc == 0 ? 1 : rc ))
     fi
 fi

@@ -13,6 +13,15 @@ whole suite on the real TPU chip).
 
 import os
 
+# -- lock-order runtime sanitizer (ISSUE 15) ------------------------------
+# Installed BEFORE any mpi_opt_tpu import so module-level locks
+# (leases._TOKEN_LOCK, trace._TID_LOCK, ...) are created through the
+# patched threading.Lock factory and come back order-tracked; locks
+# created by jax/orbax/stdlib frames stay the real primitive.
+import sanitizers  # tests/ is on sys.path (pytest's conftest-dir rule)
+
+sanitizers.install_lock_order_tracker()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -59,14 +68,16 @@ if os.environ.get("MPI_OPT_TPU_TEST_CACHE") == "1":
 PER_WORKER_TEST_BUDGET = 120
 
 
-# -- runtime sanitizers (ISSUE 9; tests/sanitizers.py) --------------------
+# -- runtime sanitizers (ISSUE 9 + 15; tests/sanitizers.py) ---------------
 #
 # Every test is followed by a leak check over process-global state:
 # non-daemon threads, SIGTERM/SIGINT dispositions, the trace sink,
-# heartbeat, integrity observer, shutdown guard + slice hook. Snapshot-
-# based (only state THIS test added fails it) so an accepted leak never
-# cascades. Opt out with @pytest.mark.leaks_ok for drills that leave
-# state on purpose.
+# heartbeat, integrity observer, shutdown guard + slice hook — plus any
+# lock-order inversion the tracker observed during the test (racelint's
+# runtime twin: per-thread acquisition order over the tracked locks,
+# reset per test). Snapshot-based (only state THIS test added fails it)
+# so an accepted leak never cascades. Opt out with @pytest.mark.leaks_ok
+# for drills that leave state on purpose.
 
 import pytest  # noqa: E402
 

@@ -10,6 +10,23 @@ baseline (a prior test's accepted leak must not cascade-fail every
 test after it); only state the test itself added and failed to clean up
 fails it.
 
+The lock-order sanitizer (ISSUE 15) is racelint's runtime twin: at
+session start, ``install_lock_order_tracker`` patches
+``threading.Lock``/``RLock`` so locks CREATED FROM mpi_opt_tpu code
+(judged by the creating frame's module — exactly the named locks the
+static symbol table discovers, tagged with the same creation site) come
+back wrapped. Every successful acquisition is recorded against the
+per-thread held set; acquiring B while holding A registers the edge
+A->B, and an acquisition whose reverse edge was already observed in
+this test's window is an ORDER INVERSION — the statically-invisible
+half of the lock-order checker, because runtime order flows through
+callbacks and dynamic dispatch the AST cannot follow. ``snapshot()``
+opens the per-test window (edges reset — two tests may legitimately
+use opposite orders on fresh lock instances); ``leaks()`` reports any
+inversion observed since. Locks created outside mpi_opt_tpu (jax,
+orbax, stdlib internals) get the real primitive: zero overhead, zero
+false positives from library internals.
+
 Wired as an autouse fixture in tests/conftest.py. Opt out per test with
 ``@pytest.mark.leaks_ok`` (registered in pytest.ini) for drills that
 intentionally leave state — e.g. SIGKILL-shaped subprocess kills whose
@@ -19,6 +36,7 @@ in-process twin deliberately abandons a wedged worker thread.
 from __future__ import annotations
 
 import signal
+import sys
 import threading
 
 #: signals the ShutdownGuard contract covers (install-on-enter,
@@ -59,6 +77,11 @@ def snapshot() -> dict:
         "beat_listener": heartbeat._LISTENER,
         "spool_faults": _spool_faults(),
         "resource_state": _resource_state(),
+        # opens the per-test lock-order window (edges reset, violation
+        # count snapshotted) — the one snapshot field that is also a
+        # boundary marker, because acquisition order is an OBSERVATION
+        # stream, not a restorable state
+        "lock_order": _TRACKER.begin_window(),
     }
 
 
@@ -165,4 +188,184 @@ def leaks(before: dict) -> list:
             "inject_enospc/inject_oom seam) — clear_observer() / the "
             "injector's uninstall() must run in a finally"
         )
+    problems.extend(_TRACKER.violations[before.get("lock_order", 0):])
     return problems
+
+
+# -- lock-order tracker (ISSUE 15) ----------------------------------------
+
+#: the REAL primitives, captured before any patching so the wrappers
+#: (and the tracker's own internal lock) never recurse into themselves
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+class _OrderTracker:
+    """Per-thread acquisition order + the observed edge graph.
+
+    Fast path: acquiring with an empty held set only appends to a
+    thread-local list. Edges/inversions are only computed when locks
+    actually nest, under a raw (untracked) internal lock.
+    """
+
+    def __init__(self):
+        self._local = threading.local()
+        self._mu = _REAL_LOCK()
+        self.edges = {}  # (id_a) -> {id_b: site}  meaning a held before b
+        self.names = {}  # lock id -> display name
+        self.violations = []  # human-readable, append-only
+
+    def _held(self):
+        h = getattr(self._local, "held", None)
+        if h is None:
+            h = self._local.held = []
+        return h
+
+    def begin_window(self) -> int:
+        """Open a per-test observation window: the edge graph resets
+        (fresh lock instances may legitimately order differently in
+        different tests) and the current violation count is the
+        baseline ``leaks`` judges against."""
+        with self._mu:
+            self.edges = {}
+        return len(self.violations)
+
+    def note_acquire(self, lock_id: int, name: str, blocking: bool = True) -> None:
+        held = self._held()
+        if held and blocking:
+            # a NON-blocking acquisition records no edge and judges no
+            # inversion — a trylock never waits, so it cannot close a
+            # deadlock cycle (the same rule the static lock-order
+            # checker applies); it still enters the held list below,
+            # because blocking acquisitions made UNDER it do wait
+            with self._mu:
+                self.names[lock_id] = name
+                for outer_id, outer_name in held:
+                    if outer_id == lock_id:
+                        continue  # reentrant RLock acquire
+                    rev = self.edges.get(lock_id, {})
+                    if outer_id in rev:
+                        self.violations.append(
+                            f"lock-order inversion: {name!r} acquired "
+                            f"while holding {outer_name!r}, but the "
+                            f"opposite nesting was observed at "
+                            f"{rev[outer_id]} — two threads taking these "
+                            "paths concurrently deadlock"
+                        )
+                    self.edges.setdefault(outer_id, {})[lock_id] = _site()
+        held.append((lock_id, name))
+
+    def note_release(self, lock_id: int) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == lock_id:
+                del held[i]
+                return
+
+
+def _site() -> str:
+    """The acquiring CALLER's file:line — the first frame above the
+    tracker/wrapper machinery AND threading.py (Condition-mediated
+    acquisitions enter via Condition.__enter__/wait), so the edge's
+    recorded site points at engine (or test) code."""
+    depth = 2
+    while True:
+        try:
+            f = sys._getframe(depth)
+        except ValueError:  # pragma: no cover - shallow stack
+            return "?"
+        fname = f.f_code.co_filename
+        if not fname.endswith(("sanitizers.py", "threading.py")):
+            return f"{fname.rsplit('/', 1)[-1]}:{f.f_lineno}"
+        depth += 1
+
+
+_TRACKER = _OrderTracker()
+
+#: monotonic TrackedLock identity — NOT id(): a garbage-collected
+#: lock's address is immediately reused by CPython's freelist, and a
+#: fresh lock inheriting a dead lock's edges would fabricate
+#: inversions between unrelated locks
+_SERIAL_MU = _REAL_LOCK()
+_SERIAL = [0]
+
+
+class TrackedLock:
+    """A Lock/RLock proxy that reports successful acquisitions and
+    releases to the order tracker. Supports the full surface the
+    engine's code (and threading.Condition wrapping one) uses:
+    context manager, ``acquire(blocking=, timeout=)``, ``release``,
+    ``locked``."""
+
+    __slots__ = ("_inner", "name", "_serial")
+
+    def __init__(self, inner, name: str):
+        self._inner = inner
+        self.name = name
+        with _SERIAL_MU:
+            _SERIAL[0] += 1
+            self._serial = _SERIAL[0]
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _TRACKER.note_acquire(self._serial, self.name, bool(blocking))
+        return got
+
+    def release(self):
+        self._inner.release()
+        _TRACKER.note_release(self._serial)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<TrackedLock {self.name} {self._inner!r}>"
+
+
+def is_tracked(lock) -> bool:
+    return isinstance(lock, TrackedLock)
+
+
+def tracked_lock(name: str) -> TrackedLock:
+    """A tracked lock by explicit request — the seeded-inversion drill
+    and the sanitizer's own unit tests."""
+    return TrackedLock(_REAL_LOCK(), name)
+
+
+_INSTALLED = False
+
+
+def install_lock_order_tracker() -> None:
+    """Patch ``threading.Lock``/``RLock`` for the session: creations
+    whose calling frame lives in mpi_opt_tpu come back tracked, tagged
+    with their creation site (module:line — the same identity the
+    static symbol table records); every other caller gets the real
+    primitive untouched. Idempotent; test-session-only by design (the
+    production CLI never imports this module)."""
+    global _INSTALLED
+    if _INSTALLED:
+        return
+    _INSTALLED = True
+
+    def _factory(real, kind):
+        def make():
+            f = sys._getframe(1)
+            mod = f.f_globals.get("__name__", "")
+            if mod.startswith("mpi_opt_tpu"):
+                name = f"{mod}:{f.f_lineno} ({kind})"
+                return TrackedLock(real(), name)
+            return real()
+
+        return make
+
+    threading.Lock = _factory(_REAL_LOCK, "Lock")
+    threading.RLock = _factory(_REAL_RLOCK, "RLock")

@@ -414,6 +414,65 @@ def test_memory_sample_on_cpu_uses_live_array_fallback():
         assert memory.measured_budget() is None  # no limit -> no budget
 
 
+def test_live_peak_is_race_safe_across_threads(monkeypatch):
+    """Regression for the racelint guarded-by finding (ISSUE 15): the
+    live-array peak is a read-modify-write shared between the staging
+    transfer thread (stage_out spans note memory) and the main loop —
+    unlocked, a racing pair could lose the larger reading or resurrect
+    a pre-reset peak into a fresh slice window. Contract: the final
+    peak equals the max in_use any sampler observed since the reset,
+    under concurrent samplers."""
+    import threading
+
+    import jax
+
+    sizes = list(range(1, 65))  # per-call nbytes, max 64
+
+    class _Arr:
+        def __init__(self, n):
+            self.nbytes = n
+
+    calls = []
+    call_lock = threading.Lock()
+
+    def fake_live_arrays():
+        with call_lock:
+            n = sizes[len(calls) % len(sizes)]
+            calls.append(n)
+        return [_Arr(n)]
+
+    monkeypatch.setattr(jax, "live_arrays", fake_live_arrays)
+
+    class NoStatsDev:
+        def memory_stats(self):
+            return None
+
+    memory.reset_peak()
+    results = []
+    res_lock = threading.Lock()
+
+    def hammer():
+        for _ in range(64):
+            m = memory.sample(NoStatsDev())
+            with res_lock:
+                results.append(m)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    final = memory.sample(NoStatsDev())
+    assert final["peak_bytes"] == max(calls)
+    # every individual reading's peak is >= its own in_use (a lost
+    # max() update would break exactly this)
+    assert all(m["peak_bytes"] >= m["bytes_in_use"] for m in results)
+    # and a reset opens a genuinely fresh window
+    memory.reset_peak()
+    m = memory.sample(NoStatsDev())
+    assert m["peak_bytes"] == m["bytes_in_use"]
+
+
 def test_measured_budget_zero_limit_means_no_budget(monkeypatch):
     """A backend whose allocator reports bytes_limit=0 has no USABLE
     limit: measured_budget must return None (falling through to the
