@@ -56,6 +56,7 @@ def all_checkers():
         LedgerGateChecker,
     )
     from mpi_opt_tpu.analysis.checkers_exit import ExitCodeChecker
+    from mpi_opt_tpu.analysis.checkers_http import HttpHandlerChecker
     from mpi_opt_tpu.analysis.checkers_jax import HostSyncChecker, KeyReuseChecker
     from mpi_opt_tpu.analysis.checkers_lease import LeaseWriteChecker
     from mpi_opt_tpu.analysis.checkers_registry import EventRegistryChecker
@@ -75,6 +76,7 @@ def all_checkers():
         CorpusIndexWriteChecker(),
         ResourceFunnelChecker(),
         FsyncBeforeRenameChecker(),
+        HttpHandlerChecker(),
         # project-pass checkers (racelint, ISSUE 15): run over the
         # repo-wide symbol table after every file is parsed
         GuardedByChecker(),

@@ -478,6 +478,36 @@ def build_parser() -> argparse.ArgumentParser:
         "no requests (unset = stay resident until `suggest-client stop` "
         "or a drain)",
     )
+    # the HTTP front door (service/http.py): put a batched, overload-
+    # safe REST endpoint in front of the suggestion server
+    p.add_argument(
+        "--http-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="with --suggest-serve: serve the HTTP front door on this "
+        "port instead of the filesystem request spool (0 = ephemeral; "
+        "the bound port publishes atomically to DIR/control/http.json). "
+        "Batched ops share one journal fsync; overload sheds with typed "
+        "503s; idempotency keys make client retries exactly-once",
+    )
+    p.add_argument(
+        "--http-queue",
+        type=int,
+        default=64,
+        metavar="N",
+        help="with --http-port: admission-queue bound — requests beyond "
+        "it shed with 503 + Retry-After instead of queueing unboundedly",
+    )
+    p.add_argument(
+        "--http-state-dir",
+        default=None,
+        metavar="DIR",
+        help="with --http-port: also expose the sweep service's "
+        "submit/status/cancel ops over HTTP against this service state "
+        "dir (the spool stays the durability layer; fencing tokens "
+        "stay the authority)",
+    )
     return p
 
 
@@ -1226,13 +1256,40 @@ def run_suggest_serve(args, parser, workload) -> int:
         n_obs=server._n_obs,
     )
     try:
-        summary = serve_loop(
-            server,
-            args.suggest_serve,
-            metrics,
-            ledger=ledger,
-            idle_timeout=args.suggest_idle_timeout,
-        )
+        if args.http_port is not None:
+            # the HTTP front door: handler threads admit, THIS thread
+            # executes (so drain/heartbeat semantics stay identical to
+            # serve_loop's); the spool dir still hosts the stop flag,
+            # the heartbeat and the endpoint file
+            from mpi_opt_tpu.service.http import FrontDoor, serve_http
+
+            spool = None
+            if args.http_state_dir:
+                from mpi_opt_tpu.service.spool import Spool
+
+                spool = Spool(args.http_state_dir)
+            front = FrontDoor(
+                suggest=server,
+                ledger=ledger,
+                spool=spool,
+                metrics=metrics,
+                queue_depth=args.http_queue,
+            )
+            summary = serve_http(
+                front,
+                args.suggest_serve,
+                metrics,
+                port=args.http_port,
+                idle_timeout=args.suggest_idle_timeout,
+            )
+        else:
+            summary = serve_loop(
+                server,
+                args.suggest_serve,
+                metrics,
+                ledger=ledger,
+                idle_timeout=args.suggest_idle_timeout,
+            )
     except SweepInterrupted as e:
         # the drain park: every report the clients saw acked is already
         # fsync-journaled, so the park is free — EX_TEMPFAIL tells the
@@ -1415,6 +1472,15 @@ def main(argv=None, *, _workload=None) -> int:
                 f"--suggest-idle-timeout must be > 0, got "
                 f"{args.suggest_idle_timeout}"
             )
+    if args.http_port is not None:
+        if not args.suggest_serve:
+            parser.error("--http-port requires --suggest-serve DIR")
+        if not 0 <= args.http_port <= 65535:
+            parser.error(f"--http-port must be in [0, 65535], got {args.http_port}")
+        if args.http_queue < 1:
+            parser.error(f"--http-queue must be >= 1, got {args.http_queue}")
+    elif args.http_state_dir is not None:
+        parser.error("--http-state-dir requires --http-port")
     # persistent compile cache (env-gated), then platform pinning, then
     # multi-host bring-up, BEFORE anything touches the XLA backend
     # (build_mesh, workload data, backend construction all do)

@@ -1,21 +1,34 @@
 """``mpi_opt_tpu suggest-client``: the suggestion service's thin client.
 
-jax-free (like every service client): requests are atomic JSON file
-drops, responses are polled reads, so an external sweep written in ANY
-language can drive the suggestion tenant by copying this ~50-line
-protocol. Subcommands::
+jax-free (like every service client). TWO transports share one answer
+schema:
+
+- the filesystem spool (requests are atomic JSON file drops, responses
+  polled reads) — so an external sweep written in ANY language can
+  drive the suggestion tenant by copying this ~50-line protocol;
+- the HTTP front door (``--url http://HOST:PORT``, service/http.py) —
+  batched ops, idempotent retries with capped jittered backoff honoring
+  Retry-After, and a typed fault funnel (corpus/transport.py) that
+  distinguishes "the server answered" from "the transport failed".
+
+Subcommands::
 
     suggest-client --dir SDIR suggest -n 8
+    suggest-client --url http://127.0.0.1:8713 suggest -n 8
     suggest-client --dir SDIR report --params '{"lr": 0.1}' --score 0.93 [--budget 20]
     suggest-client --dir SDIR lookup --params '{"lr": 0.1}' [--budget 20]
     suggest-client --dir SDIR stop
     suggest-client --dir SDIR bench --rounds 32 --batch 16
+    suggest-client --url URL bench --rounds 32 --batch 16 --burst 4
 
-``bench`` is the measured scenario (BENCH config 6): ``--rounds``
-suggest→report round trips of ``--batch`` suggestions each, every
-suggestion reported back with a synthetic quadratic score — printing
-suggestions/s and the p50/p95 request round-trip, the two numbers the
-ISSUE 14 acceptance names.
+``bench`` is the measured scenario: over the spool it is BENCH config
+6 (serial suggest→report round trips); over HTTP it is BENCH config 7
+(``--burst`` concurrent conversations of batched suggest + batched
+reports — one HTTP request and ONE journal fsync per report batch),
+printing suggestions/s, p50/p95 round trips and the p95 server-side
+queue wait. :class:`SuggestHttpClient` also memoizes ``lookup``
+answers by params key (ROADMAP 3b: repeat lookups never cross the
+wire) with explicit invalidation on ``report``.
 """
 
 from __future__ import annotations
@@ -25,6 +38,7 @@ import json
 import os
 import sys
 import time
+from collections import OrderedDict
 from typing import Optional
 
 from mpi_opt_tpu.service.spool import _read_json, _write_json_atomic
@@ -141,6 +155,218 @@ def bench(sdir: str, rounds: int, batch: int, timeout: float = 60.0) -> dict:
     }
 
 
+# -- the HTTP mode --------------------------------------------------------
+
+
+def discover_url(sdir: str, timeout: float = 10.0, poll: float = 0.05) -> str:
+    """Resolve a front door's URL from its spool's endpoint file
+    (``SDIR/control/http.json``, written atomically after the bind) —
+    how clients find an ``--http-port 0`` ephemeral server without
+    racing the bind."""
+    from mpi_opt_tpu.service.http import endpoint_path
+
+    path = endpoint_path(sdir)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        doc = _read_json(path)
+        if doc and doc.get("url"):
+            return str(doc["url"])
+        time.sleep(poll)
+    raise TimeoutError(
+        f"no HTTP endpoint published at {path} within {timeout}s — is a "
+        f"front door (--suggest-serve SDIR --http-port N) running?"
+    )
+
+
+class SuggestHttpClient:
+    """One client's conversation with the front door: batched envelopes,
+    idempotent retries, and a bounded lookup memo.
+
+    Every :meth:`batch` generates ONE idempotency key and reuses it
+    verbatim across retries, so a torn response or a server restart
+    mid-request can never double-journal a report. ``lookup`` answers
+    memoize by canonical params key; ``report`` clears the memo — a
+    report shifts the server's near-match priors for OTHER keys too, so
+    per-key invalidation would serve stale priors."""
+
+    def __init__(
+        self,
+        url: str,
+        client_id: Optional[str] = None,
+        timeout: float = 30.0,
+        retries: int = 6,
+        backoff_s: float = 0.05,
+        cache_size: int = 256,
+        sleep=time.sleep,
+    ):
+        from mpi_opt_tpu.corpus import transport
+
+        self.transport = transport.HttpTransport(url, timeout=timeout)
+        self.client_id = client_id or f"pid-{os.getpid()}"
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self.cache_size = cache_size
+        self._sleep = sleep
+        self._lookup_memo: "OrderedDict" = OrderedDict()
+        self.stats = {"batches": 0, "replayed": 0, "lookup_hits": 0}
+
+    def batch(self, ops: list, deadline_s: Optional[float] = None) -> dict:
+        from mpi_opt_tpu.corpus import transport
+
+        env = transport.envelope(ops, client=self.client_id, deadline_s=deadline_s)
+        ans = transport.call_with_retries(
+            self.transport,
+            "/v1/batch",
+            env,
+            retries=self.retries,
+            backoff_s=self.backoff_s,
+            sleep=self._sleep,
+        )
+        self.stats["batches"] += 1
+        if ans.get("replayed"):
+            self.stats["replayed"] += 1
+        return ans
+
+    def _one(self, op: dict, deadline_s: Optional[float] = None) -> dict:
+        return self.batch([op], deadline_s=deadline_s)["results"][0]
+
+    def suggest(self, n: int = 1, deadline_s: Optional[float] = None) -> dict:
+        return self._one({"op": "suggest", "n": int(n)}, deadline_s=deadline_s)
+
+    def report(self, params: dict, score: float, budget: int = 0) -> dict:
+        ans = self._one(
+            {"op": "report", "params": params, "score": float(score),
+             "budget": int(budget)}
+        )
+        self._lookup_memo.clear()
+        return ans
+
+    def lookup(self, params: dict, budget: int = 0) -> dict:
+        key = json.dumps(
+            {"params": params, "budget": int(budget)},
+            sort_keys=True, separators=(",", ":"),
+        )
+        hit = self._lookup_memo.get(key)
+        if hit is not None:
+            self._lookup_memo.move_to_end(key)
+            self.stats["lookup_hits"] += 1
+            return dict(hit)
+        ans = self._one({"op": "lookup", "params": params, "budget": int(budget)})
+        if not ans.get("error"):
+            self._lookup_memo[key] = dict(ans)
+            while len(self._lookup_memo) > self.cache_size:
+                self._lookup_memo.popitem(last=False)
+        return ans
+
+    def stop(self) -> dict:
+        return self.transport.call("/v1/stop", {})
+
+
+def bench_http(
+    url: str,
+    rounds: int,
+    batch: int,
+    burst: int = 4,
+    timeout: float = 60.0,
+    deadline_s: Optional[float] = None,
+) -> dict:
+    """BENCH config 7's measured scenario: ``burst`` concurrent clients
+    each run ``rounds`` conversations of [one suggest batch, then ALL
+    its reports in one batched request] — open-loop enough to keep the
+    admission queue non-empty, while every report still journals
+    exactly once. Reports suggestions/s over the whole conversation,
+    client round-trip p50/p95, and the SERVER-side p95 queue wait (from
+    each answer's ``queue_wait_s`` — the number the shedding bound is
+    judged on)."""
+    import threading
+
+    trips: list = []
+    waits: list = []
+    counts = {"suggestions": 0, "requests": 0, "replayed": 0}
+    lock = threading.Lock()
+    errors: list = []
+
+    def one_client(idx: int) -> None:
+        cli = SuggestHttpClient(
+            url, client_id=f"bench-{os.getpid()}-{idx}", timeout=timeout
+        )
+        try:
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                ans = cli.batch([{"op": "suggest", "n": batch}],
+                                deadline_s=deadline_s)
+                dt = time.perf_counter() - t0
+                sugg = ans["results"][0]
+                if sugg.get("error"):
+                    raise RuntimeError(f"suggest refused: {sugg['error']}")
+                got = sugg.get("params") or []
+                ops = [
+                    {"op": "report", "params": p,
+                     "score": _synthetic_score(p), "budget": 1}
+                    for p in got
+                ]
+                t1 = time.perf_counter()
+                rep = cli.batch(ops, deadline_s=deadline_s) if ops else None
+                dt2 = time.perf_counter() - t1
+                with lock:
+                    trips.append(dt)
+                    waits.append(float(ans.get("queue_wait_s") or 0.0))
+                    counts["requests"] += 1
+                    counts["suggestions"] += len(got)
+                    if rep is not None:
+                        trips.append(dt2)
+                        waits.append(float(rep.get("queue_wait_s") or 0.0))
+                        counts["requests"] += 1
+                        counts["replayed"] += int(bool(rep.get("replayed")))
+        except Exception as e:  # noqa: BLE001 - a bench worker reports, never hangs the join
+            with lock:
+                errors.append(f"client {idx}: {type(e).__name__}: {e}")
+
+    # Warm the server outside the timed window: the first suggest runs
+    # the startup sampler; reporting it pushes n_obs past n_startup so
+    # the SECOND suggest compiles the jitted acquisition path — both
+    # one-time costs the steady-state number must not absorb.
+    warm = SuggestHttpClient(url, client_id="bench-warmup", timeout=timeout)
+    got = warm.suggest(batch).get("params") or []
+    warm.batch(
+        [{"op": "report", "params": p, "score": _synthetic_score(p), "budget": 1}
+         for p in got]
+    )
+    warm.suggest(batch)
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=one_client, args=(i,), daemon=True)
+        for i in range(burst)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=max(timeout * rounds, 120.0))
+    wall = time.perf_counter() - t0
+    if errors:
+        raise RuntimeError("; ".join(errors[:3]))
+    trips_sorted = sorted(trips) or [0.0]
+    waits_sorted = sorted(waits) or [0.0]
+
+    def pct(xs, p):
+        return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+    return {
+        "rounds": rounds,
+        "batch": batch,
+        "burst": burst,
+        "suggestions": counts["suggestions"],
+        "requests": counts["requests"],
+        "replayed": counts["replayed"],
+        "wall_s": round(wall, 3),
+        "suggestions_per_sec": round(counts["suggestions"] / max(wall, 1e-9), 2),
+        "round_trip_p50_s": round(pct(trips_sorted, 0.50), 4),
+        "round_trip_p95_s": round(pct(trips_sorted, 0.95), 4),
+        "queue_wait_p50_s": round(pct(waits_sorted, 0.50), 4),
+        "queue_wait_p95_s": round(pct(waits_sorted, 0.95), 4),
+    }
+
+
 def client_main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="mpi_opt_tpu suggest-client",
@@ -149,9 +375,15 @@ def client_main(argv=None) -> int:
     )
     p.add_argument(
         "--dir",
-        required=True,
         metavar="SDIR",
         help="the suggestion spool directory (shared with the server)",
+    )
+    p.add_argument(
+        "--url",
+        metavar="URL",
+        help="HTTP front door endpoint (http://HOST:PORT); with --dir "
+        "and no --url, the spool's control/http.json is NOT consulted — "
+        "the filesystem protocol is used",
     )
     p.add_argument("--timeout", type=float, default=30.0, help="response wait")
     sub = p.add_subparsers(dest="op", required=True)
@@ -168,13 +400,24 @@ def client_main(argv=None) -> int:
     bp = sub.add_parser("bench", help="measured suggest→report round trips")
     bp.add_argument("--rounds", type=int, default=16)
     bp.add_argument("--batch", type=int, default=16)
+    bp.add_argument(
+        "--burst", type=int, default=4,
+        help="concurrent clients (HTTP mode only; the spool bench is serial)",
+    )
     args = p.parse_args(argv)
+    if not args.dir and not args.url:
+        p.error("need --dir SDIR (filesystem spool) or --url URL (HTTP)")
 
-    if args.op == "stop":
-        request_stop(args.dir)
-        print(json.dumps({"stop": True}))
-        return 0
+    from mpi_opt_tpu.corpus import transport
+    from mpi_opt_tpu.utils.exitcodes import EX_PROTOCOL, EX_UNAVAILABLE
+
     try:
+        if args.url:
+            return _http_main(args, p)
+        if args.op == "stop":
+            request_stop(args.dir)
+            print(json.dumps({"stop": True}))
+            return 0
         if args.op == "bench":
             print(json.dumps(bench(args.dir, args.rounds, args.batch, args.timeout)))
             return 0
@@ -190,8 +433,51 @@ def client_main(argv=None) -> int:
             if args.op == "report":
                 payload["score"] = args.score
         ans = round_trip(args.dir, payload, timeout=args.timeout)
+    except transport.RequestRefused as e:
+        # the server ANSWERED with a typed protocol refusal (409/400):
+        # retrying the same bytes re-refuses — distinct exit code so
+        # scripts never blind-retry a client bug
+        print(str(e), file=sys.stderr)
+        return EX_PROTOCOL
+    except transport.TransportFault as e:
+        # retries exhausted (or a non-retryable expiry): the service is
+        # unavailable from here — sysexits EX_UNAVAILABLE
+        print(str(e), file=sys.stderr)
+        return EX_UNAVAILABLE
     except (TimeoutError, RuntimeError) as e:
         print(str(e), file=sys.stderr)
         return 1
+    print(json.dumps(ans))
+    return 0 if not ans.get("error") else 1
+
+
+def _http_main(args, p) -> int:
+    """The --url route of ``client_main`` (same answer schema as the
+    spool route; transport faults propagate to client_main's funnel)."""
+    if args.op == "bench":
+        print(
+            json.dumps(
+                bench_http(
+                    args.url, args.rounds, args.batch,
+                    burst=args.burst, timeout=args.timeout,
+                )
+            )
+        )
+        return 0
+    cli = SuggestHttpClient(args.url, timeout=args.timeout)
+    if args.op == "stop":
+        print(json.dumps(cli.stop()))
+        return 0
+    if args.op == "suggest":
+        ans = cli.suggest(args.n)
+    else:
+        try:
+            params = json.loads(args.params)
+        except ValueError as e:
+            p.error(f"--params must be JSON: {e}")
+        if args.op == "report":
+            ans = cli.report(params, args.score, args.budget)
+        else:
+            ans = cli.lookup(params, args.budget)
     print(json.dumps(ans))
     return 0 if not ans.get("error") else 1

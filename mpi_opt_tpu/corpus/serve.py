@@ -192,11 +192,14 @@ class SuggestServer:
             "n_obs": self._n_obs,
         }
 
-    def report(self, req: dict, ledger=None) -> dict:
+    def report(self, req: dict, ledger=None, meta=None) -> dict:
         """One external evaluation enters the knowledge state (ring +
         cache + optional journal). ``params`` (canonical dict) or
         ``unit`` (row list) identifies the point; non-finite scores
-        journal as failed and never touch the ring."""
+        journal as failed and never touch the ring. ``meta`` rides the
+        journal record verbatim (the HTTP front door stamps its
+        idempotency key here so a restarted server can rebuild its
+        dedup index from the journal)."""
         from mpi_opt_tpu.ledger.warmstart import _decode_params
         from mpi_opt_tpu.trial import TrialResult, failed_result
 
@@ -227,7 +230,7 @@ class SuggestServer:
             # the driver's journal-before-report: a client that saw the
             # ack must find its evidence in the ledger after any crash
             ledger.record_trial(
-                result, self.space.canonical_params(params)
+                result, self.space.canonical_params(params), meta=meta
             )
         return {"ok": result.ok, "trial_id": tid, "n_obs": self._n_obs}
 
@@ -256,13 +259,13 @@ class SuggestServer:
             }
         return {"hit": None}
 
-    def handle(self, req: dict, ledger=None) -> dict:
+    def handle(self, req: dict, ledger=None, meta=None) -> dict:
         op = req.get("op")
         try:
             if op == "suggest":
                 return self.suggest(int(req.get("n") or 1))
             if op == "report":
-                return self.report(req, ledger=ledger)
+                return self.report(req, ledger=ledger, meta=meta)
             if op == "lookup":
                 return self.lookup(req)
         except (KeyError, TypeError, ValueError) as e:

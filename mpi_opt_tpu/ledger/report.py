@@ -85,6 +85,8 @@ def _service_status(path: str) -> Optional[dict]:
         "job": s.get("id"),
         "tenant": s.get("tenant"),
         "state": s.get("state"),
+        "priority": int(s.get("priority") or 0),
+        "deadline_ts": s.get("deadline_ts"),
         "slices": s.get("slices"),
         "preemptions": s.get("preemptions"),
         "program_cache": s.get("program_cache"),
@@ -213,8 +215,12 @@ def _render_text(rep: dict) -> str:
                 f" slice_elapsed={s.get('slice_elapsed_s')}s"
             )
         fleet = ""
+        if s.get("priority"):
+            fleet += f" prio={s['priority']}"
+        if s.get("deadline_ts"):
+            fleet += f" deadline_ts={s['deadline_ts']}"
         if s.get("server"):
-            fleet = f" server={s['server']}"
+            fleet += f" server={s['server']}"
         if s.get("takeovers"):
             fleet += f" takeovers={s['takeovers']}"
         lines.append(

@@ -43,6 +43,7 @@ or mixed with another stream, and loading refuses rather than guessing.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
@@ -327,6 +328,7 @@ class SweepLedger:
             self._drop_torn_boundary()
             if (self.n_torn or self.n_torn_boundary) and not self.read_only:
                 self._rewrite_complete_records()
+        self._defer_fsync = False
         if self.read_only:
             self._file = None
             return
@@ -448,12 +450,48 @@ class SweepLedger:
 
     # -- append ------------------------------------------------------------
 
+    @contextlib.contextmanager
+    def batched(self):
+        """Amortize the per-record fsync over a batch of appends: inside
+        this block ``_write_line`` writes+flushes each record but defers
+        the fsync; exit fsyncs ONCE, so the whole batch becomes durable
+        together. This is the HTTP front door's journal-before-ack at
+        batch granularity (the answer is published only after the block
+        exits). Crash-safety shape: a kill mid-batch leaves a flushed
+        prefix (page cache survives a process SIGKILL) and possibly a
+        torn tail — exactly the damage the load-time torn-tail self-heal
+        already recovers, and the client's idempotent retry re-journals
+        whatever the prefix lost. Not reentrant; single-writer only
+        (the front door's one executor thread)."""
+        if self._defer_fsync:
+            raise LedgerError("ledger.batched() does not nest")
+        self._defer_fsync = True
+        try:
+            yield self
+        finally:
+            self._defer_fsync = False
+            if self._file is not None:
+                try:
+                    self._file.flush()
+                    os.fsync(self._file.fileno())
+                except OSError as e:
+                    from mpi_opt_tpu.utils import resources
+
+                    if resources.is_storage_full(e):
+                        raise resources.StorageFull(
+                            "ledger batch fsync hit a full disk; free "
+                            "disk space and relaunch with --resume",
+                            path=self.path,
+                        ) from e
+                    raise
+
     def record_trial(
         self,
         result: TrialResult,
         canonical_params: dict,
         attempts: int = 1,
         cached: bool = False,
+        meta: Optional[dict] = None,
     ) -> dict:
         """Journal one FINAL result; durable (fsync) before returning.
 
@@ -483,6 +521,12 @@ class SweepLedger:
             "cached": bool(cached),
             "ts": round(time.time(), 4),
         }
+        if meta:
+            # extra provenance keys (the front door's idem_key/idem_op)
+            # ride the record but may not shadow the trial schema
+            for k, v in meta.items():
+                if k not in rec:
+                    rec[k] = v
         if not self.read_only:
             with trace.span("journal", n=1):
                 self._write_line(rec)
@@ -548,7 +592,8 @@ class SweepLedger:
             resources.disk_fault("ledger_fsync", self.path)
             self._file.write(json.dumps(rec) + "\n")
             self._file.flush()
-            os.fsync(self._file.fileno())
+            if not self._defer_fsync:
+                os.fsync(self._file.fileno())
         except OSError as e:
             if resources.is_storage_full(e):
                 # a full disk is an ANSWER, not a retryable blip: park

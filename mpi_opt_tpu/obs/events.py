@@ -88,6 +88,22 @@ EVENTS = frozenset(
         "suggest_serve",
         "suggest_request",
         "suggest_stop",
+        # HTTP front door (service/http.py, ISSUE 16): lifecycle
+        # (http_serve/http_stop), one http_request per executed batch,
+        # and the overload envelope — http_shed (admission queue full,
+        # typed 503), http_replayed (idempotent retry answered from the
+        # dedup window), http_expired (past-deadline work expired at
+        # dequeue, 504), breaker_open (per-client retry-storm breaker
+        # tripped, 429s for the cooldown), http_error (a contained
+        # executor fault answered as a typed 500)
+        "http_serve",
+        "http_request",
+        "http_shed",
+        "http_replayed",
+        "http_expired",
+        "breaker_open",
+        "http_error",
+        "http_stop",
         # span tracing (obs/trace.py): one event kind, span names below
         "span",
     }

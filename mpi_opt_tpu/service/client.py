@@ -93,6 +93,16 @@ def serve_main(argv) -> int:
         "default is 600 (see README: TTL tuning)",
     )
     p.add_argument(
+        "--starvation-floor",
+        type=float,
+        default=300.0,
+        metavar="S",
+        help="priority aging interval: every S seconds a queued job "
+        "waits promotes it one effective priority class, so a "
+        "saturating high-priority stream delays low-priority tenants "
+        "by a bounded number of floors, never forever",
+    )
+    p.add_argument(
         "--poll-seconds", type=float, default=0.5, help="idle spool poll interval"
     )
     p.add_argument(
@@ -133,6 +143,8 @@ def serve_main(argv) -> int:
         )
     if args.lease_ttl <= 0:
         p.error(f"--lease-ttl must be > 0, got {args.lease_ttl}")
+    if args.starvation_floor <= 0:
+        p.error(f"--starvation-floor must be > 0, got {args.starvation_floor}")
     if args.server_id is not None and (
         not args.server_id
         or not all(c.isalnum() or c in "._-" for c in args.server_id)
@@ -165,6 +177,7 @@ def serve_main(argv) -> int:
         trace=args.trace,
         server_id=args.server_id,
         lease_ttl=args.lease_ttl,
+        starvation_floor_s=args.starvation_floor,
     )
     try:
         return service.serve()
@@ -206,23 +219,59 @@ def submit_main(argv) -> int:
         help="tenant name for fair-share scheduling and concurrency caps",
     )
     p.add_argument(
+        "--priority",
+        type=int,
+        default=0,
+        metavar="N",
+        help="priority class (higher admits first, default 0; the "
+        "server's starvation floor ages waiting jobs upward so no "
+        "class starves the rest)",
+    )
+    p.add_argument(
+        "--deadline",
+        type=float,
+        default=None,
+        metavar="S",
+        help="soft deadline S seconds from now: orders admission "
+        "WITHIN a priority class (earliest deadline first); surfaced "
+        "in status/report",
+    )
+    p.add_argument(
         "sweep_args",
         nargs=argparse.REMAINDER,
         metavar="-- ARGS",
         help="sweep CLI arguments (prefix with `--`)",
     )
     args = p.parse_args(argv)
+    if args.deadline is not None and args.deadline <= 0:
+        p.error(f"--deadline must be > 0 seconds from now, got {args.deadline}")
     sweep = list(args.sweep_args)
     if sweep and sweep[0] == "--":
         sweep = sweep[1:]
     if not sweep:
         p.error("no sweep arguments given (append `-- --workload ... [flags]`)")
     spool = Spool(args.state_dir)
+    deadline_ts = None if args.deadline is None else time.time() + args.deadline
     try:
-        job_id = spool.submit(sweep, tenant=args.tenant)
+        job_id = spool.submit(
+            sweep,
+            tenant=args.tenant,
+            priority=args.priority,
+            deadline_ts=deadline_ts,
+        )
     except SpoolError as e:
         p.error(str(e))
-    print(json.dumps({"job": job_id, "tenant": args.tenant, "state": "queued"}))
+    print(
+        json.dumps(
+            {
+                "job": job_id,
+                "tenant": args.tenant,
+                "state": "queued",
+                "priority": args.priority,
+                "deadline_ts": deadline_ts,
+            }
+        )
+    )
     return 0
 
 
@@ -281,6 +330,8 @@ def _collect_status(spool: Spool) -> dict:
                 # a script polling right after submit must not see a
                 # third state the lifecycle diagram doesn't have
                 "state": tstates.QUEUED,
+                "priority": int(spec.get("priority") or 0),
+                "deadline_ts": spec.get("deadline_ts"),
             }
         )
     from mpi_opt_tpu.service.spool import live_phase
@@ -307,6 +358,8 @@ def _collect_status(spool: Spool) -> dict:
             "job": t.job_id,
             "tenant": s.get("tenant", "default"),
             "state": s.get("state"),
+            "priority": int(s.get("priority") or 0),
+            "deadline_ts": s.get("deadline_ts"),
             "slices": s.get("slices"),
             "preemptions": s.get("preemptions"),
             "boundaries": s.get("boundaries"),
@@ -393,8 +446,20 @@ def status_main(argv) -> int:
         print(line)
     if not info["jobs"]:
         print("  no jobs")
+    now = time.time()
     for j in info["jobs"]:
         extra = ""
+        if j.get("priority"):
+            extra += f"  prio={j['priority']}"
+        if j.get("deadline_ts"):
+            try:
+                left = float(j["deadline_ts"]) - now
+                extra += (
+                    f" deadline={left:+.0f}s" if left >= 0
+                    else f" deadline=OVERDUE {-left:.0f}s"
+                )
+            except (TypeError, ValueError):
+                pass
         if j.get("slices") is not None:
             extra = (
                 f"  slices={j['slices']} preemptions={j.get('preemptions')}"

@@ -113,9 +113,14 @@ class SweepService:
         trace: bool = False,
         server_id: Optional[str] = None,
         lease_ttl: float = 600.0,
+        starvation_floor_s: float = 300.0,
     ):
         if slice_boundaries < 1:
             raise ValueError(f"slice_boundaries must be >= 1, got {slice_boundaries}")
+        if starvation_floor_s <= 0:
+            raise ValueError(
+                f"starvation_floor_s must be > 0, got {starvation_floor_s}"
+            )
         if max_active_per_tenant < 1:
             raise ValueError(
                 f"max_active_per_tenant must be >= 1, got {max_active_per_tenant}"
@@ -130,6 +135,7 @@ class SweepService:
         # fencing identity every lease this server takes will carry.
         self.server_id = server_id or Spool.DEFAULT_SERVER_ID
         self.lease_ttl = float(lease_ttl)
+        self.starvation_floor_s = float(starvation_floor_s)
         self.ident = leases.ServerIdentity.local(self.server_id)
         self._takeovers = 0
         # server-registration heartbeat throttle (monotonic): refreshed
@@ -351,14 +357,27 @@ class SweepService:
         return lease if leases.expired(lease) else None
 
     def _pick_next(self) -> Optional[tuple]:
-        """Fair share: fewest-slices tenant name first, FIFO within —
-        then ACQUIRE the pick's lease. Returns ``(tenant, lease,
-        takeover_from)`` or None. Acquisition is the fleet arbiter: a
-        candidate whose lease a peer wins is skipped (never blocked
-        on), so N servers sharing the spool settle every conflict at
-        the lease file, not in scheduler logic. ``takeover_from`` is
-        the dead holder's server id when the pick was an orphaned
-        RUNNING tenant (the takeover shape), else None."""
+        """Priority class first, earliest deadline within it, then fair
+        share (fewest-slices tenant name, FIFO within) — then ACQUIRE
+        the pick's lease. Returns ``(tenant, lease, takeover_from)`` or
+        None.
+
+        The priority key is EFFECTIVE priority: the submitted class
+        plus one class per ``starvation_floor_s`` the job has waited
+        since submission — the starvation floor. A saturating stream of
+        high-priority work therefore delays low-priority tenants by a
+        bounded number of floors, never forever (a prio-0 job outranks
+        a fresh prio-2 one after 2 floors of waiting). Deadlines order
+        WITHIN a class (earliest first, deadline-less last), so urgency
+        expressed as "finish by T" and importance expressed as a class
+        stay independent axes.
+
+        Acquisition is the fleet arbiter: a candidate whose lease a
+        peer wins is skipped (never blocked on), so N servers sharing
+        the spool settle every conflict at the lease file, not in
+        scheduler logic. ``takeover_from`` is the dead holder's server
+        id when the pick was an orphaned RUNNING tenant (the takeover
+        shape), else None."""
         candidates = []
         for t in self._tenants():
             s = self._tenant_status(t)
@@ -378,12 +397,31 @@ class SweepService:
                 prior = self._takeover_candidate(t, s)
                 if prior is not None:
                     candidates.append((t, s, prior))
-        candidates.sort(
-            key=lambda tsk: (
-                self._usage.get(tsk[1].get("tenant", "default"), 0),
-                tsk[0].job_id,
+        now = time.time()
+
+        def _rank(tsk):
+            t, s, _prior = tsk
+            try:
+                prio = int(s.get("priority") or 0)
+            except (TypeError, ValueError):
+                prio = 0
+            try:
+                waited = max(0.0, now - float(s.get("submitted_ts") or now))
+            except (TypeError, ValueError):
+                waited = 0.0
+            eff_prio = prio + int(waited // self.starvation_floor_s)
+            try:
+                deadline = float(s["deadline_ts"])
+            except (KeyError, TypeError, ValueError):
+                deadline = float("inf")
+            return (
+                -eff_prio,
+                deadline,
+                self._usage.get(s.get("tenant", "default"), 0),
+                t.job_id,
             )
-        )
+
+        candidates.sort(key=_rank)
         for t, _s0, prior in candidates:
             try:
                 lease = leases.acquire(t.lease, self.ident, self.lease_ttl)

@@ -443,16 +443,30 @@ class Spool:
 
     # -- client side -------------------------------------------------
 
-    def submit(self, argv: list, tenant: str = "default") -> str:
+    def submit(
+        self,
+        argv: list,
+        tenant: str = "default",
+        priority: int = 0,
+        deadline_ts: Optional[float] = None,
+    ) -> str:
         """Drop a job file in the queue; returns the job id. The id's
         nanosecond stamp makes collisions impossible within a process
-        and sorts by submission time across processes."""
+        and sorts by submission time across processes.
+
+        ``priority`` (higher admits first) and ``deadline_ts`` (absolute
+        epoch seconds; earlier admits first within a priority class) are
+        the scheduler's sort keys ahead of fair-share — see
+        ``_pick_next``; its starvation floor promotes long-waiting
+        low-priority jobs so a priority class cannot starve the rest."""
         check_argv(argv)
         job_id = f"job-{time.time_ns():020d}-{os.getpid() % 100000:05d}"
         spec = {
             "id": job_id,
             "tenant": tenant,
             "argv": list(argv),
+            "priority": int(priority),
+            "deadline_ts": None if deadline_ts is None else float(deadline_ts),
             "submitted_ts": round(time.time(), 4),
         }
         _write_json_atomic(os.path.join(self.queue_dir, f"{job_id}.json"), spec)
@@ -569,6 +583,8 @@ class Spool:
                 "takeovers": 0,
                 "rc_history": [],
                 "program_cache": {"hits": 0, "misses": 0},
+                "priority": int(spec.get("priority") or 0),
+                "deadline_ts": spec.get("deadline_ts"),
                 "submitted_ts": spec.get("submitted_ts"),
             }
         )

@@ -38,6 +38,13 @@ The codes (sysexits.h where one exists):
   changes nothing until an operator frees the resource — launch.py
   aborts with diagnostics, budget untouched; the service PARKS the
   tenant (not terminal) so freeing disk + ``--resume`` recovers.
+- ``EX_PROTOCOL`` (76): the HTTP front door's typed protocol refusal,
+  seen from the CLIENT — the server ANSWERED, and the answer is "your
+  request is wrong" (idempotency-key reuse with a different body, a
+  malformed envelope). Retrying the same bytes re-refuses, so scripts
+  must treat it like ``EX_USAGE``, not like ``EX_UNAVAILABLE``.
+  ``suggest-client --url`` additionally maps exhausted-transport
+  retries to ``EX_UNAVAILABLE`` (69): no server answered at all.
 """
 
 from __future__ import annotations
@@ -55,6 +62,10 @@ EX_UNAVAILABLE = 69
 EX_IOERR = 74
 # sysexits.h EX_TEMPFAIL: "temporary failure, user is invited to retry"
 EX_TEMPFAIL = 75
+# sysexits.h EX_PROTOCOL: "remote system returned something invalid" —
+# repurposed client-side for the front door's typed refusals (409/400):
+# the conversation worked, the REQUEST is wrong, retries re-refuse
+EX_PROTOCOL = 76
 
 _OUTCOMES = {
     EX_OK: "ok",
@@ -63,12 +74,14 @@ _OUTCOMES = {
     EX_UNAVAILABLE: "unavailable",
     EX_IOERR: "io_error",
     EX_TEMPFAIL: "preempted",
+    EX_PROTOCOL: "protocol",
 }
 
 
 def classify(rc: int) -> str:
     """Exit code -> outcome class: ``ok`` / ``usage`` / ``data_error``
-    / ``unavailable`` / ``io_error`` / ``preempted`` / ``failure`` (the
+    / ``unavailable`` / ``io_error`` / ``preempted`` / ``protocol`` /
+    ``failure`` (the
     catch-all for every other nonzero code, including 1). ``preempted``
     is the only outcome that means "resumable, for free"; ``usage`` and
     ``data_error`` are terminal-without-retry; ``unavailable`` is the

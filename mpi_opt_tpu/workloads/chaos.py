@@ -430,6 +430,115 @@ def inject_spool_faults(
     return injector, uninstall
 
 
+# -- network-fault injectors (HTTP front door, ISSUE 16) --------------------
+#
+# The spool injectors above strike filesystem metadata; this one
+# strikes the WIRE: the HTTP client transport's chaos seam
+# (corpus/transport.net_fault) fires at the three places a real network
+# fails — before the TCP connect ("connect": refused/reset), before the
+# request body is written ("send": peer died between accept and read),
+# and before the response is read ("read": torn reply, the
+# did-it-execute ambiguity the idempotency key exists for). Same
+# direct-call discipline: install, drive the drill, uninstall in a
+# finally; faults fire on exact per-stage op ordinals from a seeded
+# draw, never by wall clock.
+
+
+class NetFaultInjector:
+    """The schedule ``inject_net`` installs into
+    ``corpus.transport``'s net-fault seam. Counts every transport op
+    per stage ("connect" / "send" / "read") and fires the scheduled
+    ordinals: connect/send ordinals raise :class:`transport.Unreachable`
+    (connection refused), read ordinals raise
+    :class:`transport.TornResponse` (reply died mid-flight — the
+    request MAY have executed), and ``delay_s`` sleeps before every
+    faulted-read's raise is decided, on its own seeded schedule
+    (``delay`` ordinals), modeling the slow-reply shape. Thread-safe:
+    bench/drill clients retry from many threads through one seam."""
+
+    def __init__(
+        self,
+        refuse: int = 0,
+        torn: int = 0,
+        delay: int = 0,
+        delay_s: float = 0.05,
+        seed: int = 0,
+        ops_window: int | None = None,
+    ):
+        import threading
+
+        self.delay_s = float(delay_s)
+        self._lock = threading.Lock()
+        self._counts = {"connect": 0, "send": 0, "read": 0}
+        self._fail = {
+            "connect": SpoolFaultInjector._schedule("net-refuse", refuse, seed, ops_window),
+            "read": SpoolFaultInjector._schedule("net-torn", torn, seed, ops_window),
+        }
+        self._delay = SpoolFaultInjector._schedule("net-delay", delay, seed, ops_window)
+        self.faults_fired = {"refuse": 0, "torn": 0, "delay": 0}
+
+    def __call__(self, stage: str, url: str) -> None:
+        from mpi_opt_tpu.corpus.transport import TornResponse, Unreachable
+
+        with self._lock:
+            ordinal = self._counts.get(stage, 0)
+            self._counts[stage] = ordinal + 1
+            fire = ordinal in self._fail.get(stage, ())
+            delay = stage == "read" and ordinal in self._delay
+            if fire:
+                self.faults_fired["refuse" if stage == "connect" else "torn"] += 1
+            if delay:
+                self.faults_fired["delay"] += 1
+        if delay:
+            time.sleep(self.delay_s)
+        if not fire:
+            return
+        if stage == "connect":
+            raise Unreachable(
+                f"chaos: injected connection refused (op {ordinal}) to {url}"
+            )
+        raise TornResponse(
+            f"chaos: injected torn response (op {ordinal}) from {url}"
+        )
+
+
+def inject_net(
+    refuse: int = 0,
+    torn: int = 0,
+    delay: int = 0,
+    delay_s: float = 0.05,
+    seed: int = 0,
+    ops_window: int | None = None,
+):
+    """Install a seeded, deterministic network-fault schedule on the
+    HTTP transport seam. Returns ``(injector, uninstall)`` — call
+    ``uninstall()`` when the drill is over (tests in a finally).
+    ``refuse`` connect ordinals are refused, ``torn`` read ordinals
+    tear the reply, ``delay`` read ordinals sleep ``delay_s`` first;
+    with ``ops_window`` each schedule is a seeded sample of that window
+    instead of the first n. The client's capped jittered retry absorbs
+    schedules shorter than its attempt budget — and because every retry
+    reuses its idempotency key, a torn-but-executed request is answered
+    from the server's dedup window, which is exactly what the
+    exactly-once drill pins."""
+    from mpi_opt_tpu.corpus import transport
+
+    injector = NetFaultInjector(
+        refuse=refuse,
+        torn=torn,
+        delay=delay,
+        delay_s=delay_s,
+        seed=seed,
+        ops_window=ops_window,
+    )
+    transport.set_net_fault_injector(injector)
+
+    def uninstall() -> None:
+        transport.set_net_fault_injector(None)
+
+    return injector, uninstall
+
+
 @register
 class ChaosWorkload(Workload):
     name = "chaos"

@@ -22,6 +22,7 @@
 #        T1_SKIP_OOM_DRILL=1 probes/tier1.sh # skip the device-OOM backoff drill
 #        T1_SKIP_ENOSPC_DRILL=1 probes/tier1.sh # skip the disk-full drill
 #        T1_SKIP_CORPUS_DRILL=1 probes/tier1.sh # skip the corpus/auto-warm-start drill
+#        T1_SKIP_FRONTDOOR_DRILL=1 probes/tier1.sh # skip the HTTP front-door drill
 set -o pipefail
 cd "$(dirname "$0")/.."
 T1_LOG="${T1_LOG:-/tmp/_t1.log}"
@@ -580,6 +581,155 @@ PYEOF
         echo "CORPUS_DRILL=pass"
     else
         echo "CORPUS_DRILL=FAIL"
+        rc=$(( rc == 0 ? 1 : rc ))
+    fi
+fi
+
+# -- front-door drill (overload-safe HTTP transport, service/http.py; ISSUE 16) --
+# The overload + exactly-once acceptance in one pass. Against a live
+# front door with a 1-deep admission queue: a 24-thread suggest storm
+# must be ANSWERED — typed 503 sheds for the overflow (never a hang),
+# bounded queue wait for the admitted. Then the durability half: one
+# report batch lands, a second is in flight WHILE the server is
+# SIGKILLed, a restarted server (--resume, same journal, new port)
+# absorbs the client's idempotent retries THROUGH seeded network
+# faults (refused connect + torn reply), a key reused with a different
+# body is refused 409 — and the ledger must hold exactly ONE record
+# per (idem_key, idem_op), passing report --validate.
+if [ -z "$T1_SKIP_FRONTDOOR_DRILL" ]; then
+    fd_rc=0
+    FDD=$(mktemp -d /tmp/_t1_fdoor.XXXXXX)
+    timeout -k 10 420 env JAX_PLATFORMS=cpu python - "$FDD" >/dev/null 2>&1 <<'PYEOF' || fd_rc=1
+import json, os, signal, subprocess, sys, threading, time
+d = sys.argv[1]
+spool, led = os.path.join(d, "spool"), os.path.join(d, "suggest.jsonl")
+env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+def start_server(extra=()):
+    return subprocess.Popen(
+        [sys.executable, "-m", "mpi_opt_tpu", "--workload", "quadratic",
+         "--suggest-serve", spool, "--suggest-idle-timeout", "120",
+         "--http-port", "0", "--http-queue", "1", "--seed", "0",
+         "--ledger", led, *extra],
+        cwd=os.getcwd(), env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+from mpi_opt_tpu.corpus import transport
+from mpi_opt_tpu.corpus.client import discover_url
+from mpi_opt_tpu.service.http import endpoint_path
+
+def wait_url(pid, timeout=120):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            doc = json.load(open(endpoint_path(spool)))
+            if doc.get("pid") == pid:
+                return doc["url"]
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+    raise TimeoutError(f"no endpoint from pid {pid}")
+
+def ready(t):
+    probe = transport.envelope([{"op": "suggest", "n": 1}], client="probe")
+    transport.call_with_retries(t, "/v1/batch", probe, retries=8, backoff_s=0.25)
+
+a = start_server()
+url = wait_url(a.pid)
+t = transport.HttpTransport(url, timeout=30)
+ready(t)
+params = t.call("/v1/batch", transport.envelope(
+    [{"op": "suggest", "n": 6}], client="drill"))["results"][0]["params"]
+
+# -- overload: 24 threads x 8 raw calls against a 1-deep queue --------
+lock = threading.Lock()
+stats = {"shed": 0, "answered": 0}
+waits, problems = [], []
+def storm(i):
+    tr = transport.HttpTransport(url, timeout=30)
+    for _ in range(8):
+        try:
+            ans = tr.call("/v1/batch", transport.envelope(
+                [{"op": "suggest", "n": 64}], client=f"storm-{i}"))
+            with lock:
+                stats["answered"] += 1
+                waits.append(float(ans["queue_wait_s"]))
+        except (transport.Overloaded, transport.BreakerOpen):
+            with lock:
+                stats["shed"] += 1
+        except transport.TransportFault as e:
+            with lock:
+                problems.append(f"storm-{i}: {type(e).__name__}: {e}")
+threads = [threading.Thread(target=storm, args=(i,), daemon=True)
+           for i in range(24)]
+for th in threads:
+    th.start()
+for th in threads:
+    th.join(timeout=180)
+assert not any(th.is_alive() for th in threads), "a storm call HUNG"
+assert not problems, problems[:3]
+assert stats["shed"] >= 1, stats       # overload produced typed 503s
+assert stats["answered"] >= 1, stats   # ...while admitted work was served
+waits.sort()
+p95 = waits[min(len(waits) - 1, int(0.95 * len(waits)))]
+assert p95 < 10.0, f"admitted p95 queue wait {p95}s"  # bounded, 1-deep queue
+
+# -- exactly-once: batch 1 lands, batch 2 in flight at SIGKILL --------
+def report_env(key, ps):
+    return transport.envelope(
+        [{"op": "report", "params": p, "score": 0.5, "budget": 1} for p in ps],
+        key=key, client="drill")
+e1, e2 = report_env("drill-k1", params[:3]), report_env("drill-k2", params[3:])
+ans1 = transport.call_with_retries(t, "/v1/batch", e1, retries=4)
+assert not any(r.get("error") for r in ans1["results"]), ans1
+killer = threading.Timer(0.05, lambda: a.kill())
+killer.start()
+try:
+    transport.call_with_retries(t, "/v1/batch", e2, retries=2, backoff_s=0.05)
+except transport.TransportFault:
+    pass  # died mid-request: EITHER way the retry below must be exactly-once
+killer.join()
+assert a.wait(timeout=60) == -signal.SIGKILL
+
+b = start_server(["--resume"])
+url2 = wait_url(b.pid)
+t2 = transport.HttpTransport(url2, timeout=30)
+ready(t2)
+# the client's idempotent retries, through seeded refuse+torn faults
+from mpi_opt_tpu.workloads.chaos import inject_net
+injector, uninstall = inject_net(refuse=1, torn=1, seed=3)
+try:
+    ans2 = transport.call_with_retries(t2, "/v1/batch", e2, retries=8,
+                                       backoff_s=0.05)
+finally:
+    uninstall()
+assert not any(r.get("error") for r in ans2["results"]), ans2
+assert injector.faults_fired["refuse"] == 1 and injector.faults_fired["torn"] == 1
+# batch 1's retry into the RESTART answers from the journal, no re-journal
+re1 = transport.call_with_retries(t2, "/v1/batch", e1, retries=4)
+assert all(r.get("journal_replayed") for r in re1["results"]), re1
+# same key, different body: refused loudly, never replayed
+try:
+    t2.call("/v1/batch", report_env("drill-k1", params[3:]))
+    raise AssertionError("key reuse with a different body was accepted")
+except transport.KeyConflict:
+    pass
+t2.call("/v1/stop", {})
+assert b.wait(timeout=120) == 0
+recs = [json.loads(line) for line in open(led).read().splitlines()[1:]]
+seen = [(r.get("idem_key"), r.get("idem_op")) for r in recs
+        if r.get("idem_key")]
+assert sorted(seen) == sorted(set(seen)), "a report journaled TWICE"
+assert sorted(seen) == [("drill-k1", 0), ("drill-k1", 1), ("drill-k1", 2),
+                        ("drill-k2", 0), ("drill-k2", 1), ("drill-k2", 2)], seen
+PYEOF
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        report --validate "$FDD/suggest.jsonl" >/dev/null 2>&1 || fd_rc=1
+    rm -rf "$FDD"
+    if [ $fd_rc -eq 0 ]; then
+        echo "FRONTDOOR_DRILL=pass"
+    else
+        echo "FRONTDOOR_DRILL=FAIL"
         rc=$(( rc == 0 ? 1 : rc ))
     fi
 fi

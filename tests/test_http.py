@@ -1,0 +1,561 @@
+"""HTTP front door (ISSUE 16): wire protocol, typed transport faults,
+bounded admission + shedding, the idempotency window (memory half AND
+the ledger-durable half), deadline expiry, the per-client breaker, the
+chaos net seam, and the ``http-handler-contained`` checker.
+
+The headline is the exactly-once drill in miniature: a report batch
+retried through injected connection-refused + torn-response faults — and
+replayed again into a RESTARTED front door over the same journal —
+leaves exactly one ledger record per (idem_key, idem_op), while the
+whole batch costs one fsync.
+"""
+
+import contextlib
+import json
+import os
+import queue
+import textwrap
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from mpi_opt_tpu.analysis import check_source
+from mpi_opt_tpu.analysis.checkers_http import HttpHandlerChecker
+from mpi_opt_tpu.corpus import transport
+from mpi_opt_tpu.corpus.client import SuggestHttpClient, discover_url
+from mpi_opt_tpu.corpus.serve import SuggestServer
+from mpi_opt_tpu.ledger import SweepLedger
+from mpi_opt_tpu.service.http import FrontDoor, _Work, endpoint_path, serve_http
+from mpi_opt_tpu.utils.metrics import MetricsLogger, null_logger
+from mpi_opt_tpu.workloads import get_workload
+
+_FAST_SLEEP = lambda s: time.sleep(min(s, 0.01))  # noqa: E731 - test retry pacing
+
+
+def live_space():
+    return get_workload("quadratic").default_space()
+
+
+def _env(ops, key=None, client="t", deadline_s=None):
+    return transport.envelope(ops, key=key, client=client, deadline_s=deadline_s)
+
+
+def _noop_ops(tag="a"):
+    # unknown ops execute without any backend: the result is an answered
+    # per-op error, which is exactly what admission tests need
+    return [{"op": "noop", "tag": tag}]
+
+
+@contextlib.contextmanager
+def executor_thread(front):
+    """Drive a FrontDoor's queue the way serve_http's caller thread
+    does, without a socket."""
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            try:
+                work = front.queue.get(timeout=0.01)
+            except queue.Empty:
+                continue
+            front.run_one(work)
+
+    th = threading.Thread(target=loop, daemon=True)
+    th.start()
+    try:
+        yield
+    finally:
+        stop.set()
+        th.join(timeout=5)
+
+
+def _suggest_front(tmp_path, name="fd", **fd_kw):
+    space = live_space()
+    led = SweepLedger(str(tmp_path / f"{name}.jsonl"))
+    led.ensure_header(
+        {"mode": "suggest", "algorithm": "tpe", "workload": "quadratic",
+         "backend": "suggest", "seed": 0, "space_hash": space.space_hash()},
+        space_spec=space.spec(),
+    )
+    server = SuggestServer(space, seed=0)
+    return FrontDoor(suggest=server, ledger=led, **fd_kw), led
+
+
+@contextlib.contextmanager
+def front_door(tmp_path, name="fd", metrics=None, **fd_kw):
+    """A real served front door: serve_http in a thread, URL discovered
+    from the endpoint file, stopped via POST /v1/stop."""
+    front, led = _suggest_front(tmp_path, name=name, **fd_kw)
+    sdir = str(tmp_path / f"{name}-spool")
+    box = {}
+
+    def run():
+        try:
+            box["summary"] = serve_http(
+                front, sdir, metrics or null_logger(), poll_seconds=0.01
+            )
+        except BaseException as e:  # noqa: BLE001 - surfaced after join
+            box["error"] = e
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    try:
+        url = discover_url(sdir, timeout=20)
+        yield url, front, led, sdir, box
+    finally:
+        with contextlib.suppress(Exception):
+            transport.HttpTransport(url, timeout=5).call("/v1/stop", {})
+        th.join(timeout=20)
+        led.close()
+        if "error" in box:
+            raise box["error"]
+
+
+def _ledger_lines(path):
+    return [json.loads(line) for line in open(path).read().splitlines()[1:]]
+
+
+# -- wire protocol / envelope helpers -------------------------------------
+
+
+def test_ops_digest_is_canonical():
+    a = [{"op": "report", "score": 1.0, "params": {"lr": 0.1, "reg": 0.2}}]
+    b = [{"params": {"reg": 0.2, "lr": 0.1}, "score": 1.0, "op": "report"}]
+    assert transport.ops_digest(a) == transport.ops_digest(b)  # key order
+    assert transport.ops_digest(a) != transport.ops_digest(a + a)  # op order/count
+
+
+def test_envelope_carries_absolute_deadline_and_fresh_keys():
+    e1 = transport.envelope([{"op": "suggest"}], deadline_s=5.0)
+    e2 = transport.envelope([{"op": "suggest"}])
+    assert e1["version"] == transport.WIRE_VERSION
+    assert e1["key"] != e2["key"] and len(e1["key"]) == 32
+    assert abs(e1["deadline_ts"] - (time.time() + 5.0)) < 1.0
+    assert e2["deadline_ts"] is None
+    assert e1["digest"] == transport.ops_digest(e1["ops"])
+
+
+def test_is_retryable_walks_cause_chain():
+    over = transport.Overloaded("q full")
+    wrapped = RuntimeError("wrapped")
+    wrapped.__cause__ = over
+    assert transport.is_retryable(wrapped) is True
+    expired = RuntimeError("wrapped")
+    expired.__cause__ = transport.DeadlineExpired("late")
+    assert transport.is_retryable(expired) is False
+    assert transport.is_retryable(RuntimeError("plain")) is False
+    assert isinstance(transport.KeyConflict("x"), transport.RequestRefused)
+    assert transport.KeyConflict("x").retryable is False
+
+
+def test_jitter_is_deterministic_and_bounded():
+    vals = [transport._jitter("k", a) for a in range(16)]
+    assert vals == [transport._jitter("k", a) for a in range(16)]
+    assert all(0.5 <= v < 1.5 for v in vals)
+    assert len(set(vals)) > 8  # actually varies across attempts
+
+
+class _StubTransport:
+    def __init__(self, faults):
+        self.faults = list(faults)
+        self.payloads = []
+
+    def call(self, path, payload):
+        self.payloads.append(payload)
+        if self.faults:
+            raise self.faults.pop(0)
+        return {"ok": True, "key": payload["key"]}
+
+
+def test_call_with_retries_reuses_payload_and_honors_retry_after():
+    stub = _StubTransport(
+        [transport.Unreachable("refused"),
+         transport.Overloaded("shed", retry_after=0.7)]
+    )
+    delays = []
+    env = _env(_noop_ops())
+    ans = transport.call_with_retries(
+        stub, "/v1/batch", env, retries=6, backoff_s=0.01, sleep=delays.append
+    )
+    assert ans["ok"] is True and ans["key"] == env["key"]
+    # the SAME payload object (and key) every attempt: what makes the
+    # retry idempotent on the server side
+    assert all(p is env for p in stub.payloads) and len(stub.payloads) == 3
+    assert len(delays) == 2 and delays[1] >= 0.7  # Retry-After is a floor
+
+
+def test_call_with_retries_raises_nonretryable_immediately_and_exhausts():
+    stub = _StubTransport([transport.KeyConflict("409")])
+    with pytest.raises(transport.KeyConflict):
+        transport.call_with_retries(stub, "/v1/batch", _env(_noop_ops()),
+                                    sleep=lambda s: None)
+    assert len(stub.payloads) == 1
+    stub = _StubTransport([transport.TornResponse("torn")] * 3)
+    with pytest.raises(transport.TornResponse):
+        transport.call_with_retries(stub, "/v1/batch", _env(_noop_ops()),
+                                    retries=2, sleep=lambda s: None)
+    assert len(stub.payloads) == 3  # initial + 2 retries
+
+
+# -- HTTP status -> typed fault mapping (canned server) --------------------
+
+
+class _CannedHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # noqa: D102
+        pass
+
+    def do_POST(self):  # noqa: N802
+        self.rfile.read(int(self.headers.get("Content-Length") or 0))
+        if self.path == "/torn":
+            raw, code = b"{half a reply", 200
+        else:
+            code = int(self.path.rsplit("/", 1)[1])
+            raw = (b'{"ok": true}' if code == 200 else
+                   json.dumps({"error": {"kind": "canned", "detail": "x"}}).encode())
+        self.send_response(code)
+        if code in (503, 429):
+            self.send_header("Retry-After", "1.5")
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+
+def test_transport_status_mapping():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _CannedHandler)
+    th = threading.Thread(target=httpd.serve_forever,
+                          kwargs={"poll_interval": 0.05}, daemon=True)
+    th.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    t = transport.HttpTransport(url, timeout=5)
+    try:
+        assert t.call("/code/200", {}) == {"ok": True}
+        for code, exc in [(503, transport.Overloaded), (429, transport.BreakerOpen),
+                          (504, transport.DeadlineExpired), (409, transport.KeyConflict),
+                          (400, transport.RequestRefused), (404, transport.RequestRefused),
+                          (500, transport.TornResponse)]:
+            with pytest.raises(exc) as ei:
+                t.call(f"/code/{code}", {})
+            if code in (503, 429):
+                assert ei.value.retry_after == 1.5
+        with pytest.raises(transport.TornResponse):
+            t.call("/torn", {})
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        th.join(timeout=5)
+    # the now-dead endpoint: nobody answers -> Unreachable
+    with pytest.raises(transport.Unreachable):
+        t.call("/code/200", {})
+
+
+# -- FrontDoor admission (no socket) ---------------------------------------
+
+
+def test_validate_refuses_malformed_envelopes():
+    front = FrontDoor()
+    bad = [
+        "not a dict",
+        {"key": "k", "ops": []},  # empty ops
+        {"key": "", "ops": _noop_ops()},  # empty key
+        {"ops": _noop_ops()},  # no key
+        {"key": "k", "ops": "nope"},  # ops not a list
+        {"key": "k", "ops": [1, 2]},  # ops not objects
+        {"key": "k", "ops": _noop_ops(), "version": 99},  # future wire
+        {"key": "k", "ops": _noop_ops(), "digest": "feed"},  # digest lies
+        {"key": "k", "ops": _noop_ops(), "deadline_ts": "soon"},  # bad deadline
+        {"key": "k", "ops": [{"op": "x"}] * 1025},  # over the batch cap
+    ]
+    for env in bad:
+        refused = front.validate(env)
+        assert refused is not None and refused[0] == 400, env
+    assert front.validate({"key": "k", "ops": _noop_ops()}) is None
+
+
+def test_admit_executes_then_replays_byte_identical_retry():
+    front = FrontDoor()
+    env = _env(_noop_ops())
+    with executor_thread(front):
+        status, body, _ = front.admit(env)
+        assert status == 200 and body["replayed"] is False
+        assert "unknown op" in body["results"][0]["error"]
+        status2, body2, _ = front.admit(dict(env))
+        assert status2 == 200 and body2["replayed"] is True
+        assert body2["results"] == body["results"]
+    assert front.counters["batches"] == 1 and front.counters["replayed"] == 1
+
+
+def test_same_key_different_body_is_409_never_replayed():
+    front = FrontDoor()
+    env = _env(_noop_ops("a"))
+    with executor_thread(front):
+        assert front.admit(env)[0] == 200
+        status, body, _ = front.admit(_env(_noop_ops("b"), key=env["key"]))
+    assert status == 409 and body["error"]["kind"] == "key_conflict"
+    assert front.counters["conflicts"] == 1 and front.counters["batches"] == 1
+
+
+def test_window_evicts_oldest_and_reexecutes_evicted_key():
+    front = FrontDoor(window_size=2)
+    envs = [_env(_noop_ops(t)) for t in "abc"]
+    with executor_thread(front):
+        for env in envs:
+            assert front.admit(env)[0] == 200
+        assert len(front._window) == 2  # "a" evicted
+        status, body, _ = front.admit(dict(envs[0]))
+        assert status == 200 and body["replayed"] is False  # re-executed
+    assert front.counters["batches"] == 4 and front.counters["replayed"] == 0
+
+
+def test_shed_at_queue_bound_then_breaker_trips():
+    front = FrontDoor(queue_depth=1, breaker_strikes=2, breaker_cooldown_s=30.0)
+    front.queue.put_nowait(object())  # wedge the queue at capacity
+    s1, b1, ra1 = front.admit(_env(_noop_ops("a"), client="storm"))
+    assert s1 == 503 and b1["error"]["kind"] == "overloaded"
+    assert ra1 == front.shed_retry_after_s
+    s2, _, _ = front.admit(_env(_noop_ops("b"), client="storm"))
+    assert s2 == 503  # second strike: the breaker trips
+    s3, b3, ra3 = front.admit(_env(_noop_ops("c"), client="storm"))
+    assert s3 == 429 and b3["error"]["kind"] == "breaker_open" and ra3 > 0
+    # an unrelated client is NOT punished for the storm
+    s4, b4, _ = front.admit(_env(_noop_ops("d"), client="calm"))
+    assert s4 == 503 and b4["error"]["kind"] == "overloaded"
+    assert front.counters["shed"] == 3 and front.counters["breaker_trips"] == 1
+
+
+def test_wedged_executor_answers_typed_503_not_a_hang():
+    front = FrontDoor(max_wait_s=0.05)  # nobody drains the queue
+    t0 = time.monotonic()
+    status, body, retry_after = front.admit(_env(_noop_ops()))
+    assert status == 503 and "no executor answer" in body["error"]["detail"]
+    assert retry_after is not None
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_concurrent_same_key_retry_attaches_to_inflight_work():
+    front = FrontDoor()
+    env = _env(_noop_ops())
+    answers = []
+
+    def admit(e):
+        answers.append(front.admit(e))
+
+    t1 = threading.Thread(target=admit, args=(dict(env),), daemon=True)
+    t1.start()
+    deadline = time.monotonic() + 5
+    while not front._pending and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert front._pending  # first admit is parked in flight
+    t2 = threading.Thread(target=admit, args=(dict(env),), daemon=True)
+    t2.start()
+    while front._pending[env["key"]].waiters < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    with executor_thread(front):
+        t1.join(timeout=5)
+        t2.join(timeout=5)
+    statuses = sorted(a[0] for a in answers)
+    assert statuses == [200, 200]
+    # ONE execution answered both waiters; the attached retry is marked
+    assert front.counters["batches"] == 1
+    assert sorted(a[1]["replayed"] for a in answers) == [False, True]
+
+
+def test_deadline_expired_at_dequeue_is_504():
+    front = FrontDoor()
+    env = _env(_noop_ops(), deadline_s=-0.5)  # already late on arrival
+    with executor_thread(front):
+        status, body, _ = front.admit(env)
+    assert status == 504 and body["error"]["kind"] == "deadline_expired"
+    assert front.counters["expired"] == 1 and front.counters["batches"] == 0
+
+
+# -- the durable half: reports journal exactly once ------------------------
+
+
+def test_report_batch_costs_one_fsync_and_stamps_idem_meta(tmp_path, monkeypatch):
+    front, led = _suggest_front(tmp_path)
+    params = front.suggest.suggest(3)["params"]
+    ops = [{"op": "report", "params": p, "score": 0.5, "budget": 1} for p in params]
+    env = _env(ops, key="k-batch")
+    assert front.validate(env) is None
+    fsyncs = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync", lambda fd: (fsyncs.append(fd), real_fsync(fd)))
+    work = _Work(env)
+    front.run_one(work)
+    assert work.status == 200
+    assert [r["trial_id"] for r in work.response["results"]] == [0, 1, 2]
+    # the tentpole's amortization claim: 3 journaled reports, ONE fsync
+    assert len(fsyncs) == 1
+    recs = _ledger_lines(led.path)
+    assert [(r["idem_key"], r["idem_op"]) for r in recs] == [
+        ("k-batch", 0), ("k-batch", 1), ("k-batch", 2)
+    ]
+    led.close()
+
+
+def test_restarted_front_door_replays_reports_from_its_journal(tmp_path):
+    front, led = _suggest_front(tmp_path)
+    params = front.suggest.suggest(2)["params"]
+    ops = [{"op": "report", "params": p, "score": 0.25, "budget": 1} for p in params]
+    env = _env(ops, key="k-durable")
+    with executor_thread(front):
+        status, body, _ = front.admit(dict(env))
+        assert status == 200 and not any(r.get("error") for r in body["results"])
+    assert len(_ledger_lines(led.path)) == 2
+    led.close()  # the first server is gone; only its journal survives
+
+    led2 = SweepLedger(str(led.path))
+    assert len(led2.records) == 2
+    server2 = SuggestServer(live_space(), seed=0)
+    server2.seed_from_ledger(led2.records)
+    front2 = FrontDoor(suggest=server2, ledger=led2)
+    # the client's retry reaches the RESTART with the same key: the
+    # journal-seeded index answers it without journaling again
+    work = _Work(transport.envelope(ops, key="k-durable", client="t"))
+    front2.run_one(work)
+    assert work.status == 200
+    assert all(r.get("journal_replayed") for r in work.response["results"])
+    assert [r["trial_id"] for r in work.response["results"]] == [0, 1]
+    assert len(_ledger_lines(led2.path)) == 2  # exactly once, across the restart
+    led2.close()
+
+
+# -- end to end over a real socket ----------------------------------------
+
+
+def test_e2e_suggest_report_lookup_deadline_and_lifecycle(tmp_path):
+    mpath = tmp_path / "fd-metrics.jsonl"
+    metrics = MetricsLogger(path=str(mpath))
+    with front_door(tmp_path, metrics=metrics) as (url, front, led, sdir, box):
+        cli = SuggestHttpClient(url, client_id="e2e", timeout=10, sleep=_FAST_SLEEP)
+        ans = cli.suggest(3)
+        params = ans["params"]
+        assert len(params) == 3
+        rep = cli.batch(
+            [{"op": "report", "params": p, "score": 0.5, "budget": 1}
+             for p in params]
+        )
+        assert [r["trial_id"] for r in rep["results"]] == [0, 1, 2]
+        # lookup memo: second hit never leaves the process
+        before = front.counters["ops"]
+        first = cli.lookup(params[0], budget=1)
+        again = cli.lookup(params[0], budget=1)
+        assert again == first and cli.stats["lookup_hits"] == 1
+        assert front.counters["ops"] == before + 1
+        # a report invalidates the memo (priors moved for every key)
+        cli.report(params[1], 0.75, budget=1)
+        cli.lookup(params[0], budget=1)
+        assert front.counters["ops"] == before + 3  # re-fetched, not served stale
+        # single-op REST endpoints share the batch machinery
+        t = transport.HttpTransport(url, timeout=10)
+        one = t.call("/v1/suggest", {"n": 2, "client": "e2e-rest"})
+        assert len(one["results"][0]["params"]) == 2
+        health = t.call("/v1/healthz", method="GET")
+        assert health["ok"] is True and health["queue_depth"] == front.queue.maxsize
+        # a dead-on-arrival deadline is expired, never served late
+        with pytest.raises(transport.DeadlineExpired):
+            t.call("/v1/batch", _env([{"op": "suggest", "n": 1}], deadline_s=-0.5))
+        with pytest.raises(transport.RequestRefused):
+            t.call("/v1/nope", {})
+    assert box["summary"]["stopped"] is True
+    assert box["summary"]["reports"] == 4 and box["summary"]["expired"] == 1
+    assert not os.path.exists(endpoint_path(sdir))  # endpoint file retired
+    events = [json.loads(line)["event"] for line in open(mpath)]
+    for name in ("http_serve", "http_request", "http_expired", "http_stop"):
+        assert name in events, name
+
+
+def test_e2e_chaos_net_faults_keep_reports_exactly_once(tmp_path):
+    from mpi_opt_tpu.workloads.chaos import inject_net
+
+    with front_door(tmp_path, name="chaos") as (url, front, led, sdir, box):
+        cli = SuggestHttpClient(url, client_id="chaos", timeout=10,
+                                sleep=_FAST_SLEEP)
+        params = cli.suggest(2)["params"]
+        ops = [{"op": "report", "params": p, "score": 0.5, "budget": 1}
+               for p in params]
+        # first transport op: connection refused; second: executed but
+        # the reply is torn mid-read; third: answered from the window
+        injector, uninstall = inject_net(refuse=1, torn=1, seed=3)
+        try:
+            rep = cli.batch(ops)
+        finally:
+            uninstall()
+        assert injector.faults_fired["refuse"] == 1
+        assert injector.faults_fired["torn"] == 1
+        assert not any(r.get("error") for r in rep["results"])
+        assert rep["replayed"] is True  # the torn attempt HAD executed
+    recs = _ledger_lines(led.path)
+    seen = [(r["idem_key"], r["idem_op"]) for r in recs]
+    assert len(seen) == len(set(seen)) == 2  # one record per report, ever
+
+
+# -- the http-handler-contained checker ------------------------------------
+
+
+def _lint(src):
+    return check_source(textwrap.dedent(src), path="service/http.py",
+                        checkers=[HttpHandlerChecker()])
+
+
+def test_handler_checker_accepts_contained_handler():
+    assert _lint(
+        """
+        class GoodHandler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                "docstring is fine"
+                try:
+                    self._answer(200, {})
+                except Exception:
+                    self._answer(500, {})
+
+            def helper(self):
+                return 1  # non-do_* methods are not judged
+        """
+    ) == []
+
+
+def test_handler_checker_flags_statements_outside_try():
+    findings = _lint(
+        """
+        class LeakyHandler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(10)  # raises before containment
+                try:
+                    self._answer(200, {})
+                except Exception:
+                    pass
+        """
+    )
+    assert len(findings) == 1 and "outside its containment try" in findings[0].message
+
+
+def test_handler_checker_flags_narrow_except():
+    findings = _lint(
+        """
+        class NarrowHandler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                try:
+                    self._answer(200, {})
+                except (ValueError, OSError):
+                    pass
+        """
+    )
+    assert len(findings) == 1 and "never catches Exception" in findings[0].message
+
+
+def test_handler_checker_ignores_non_handler_classes():
+    assert _lint(
+        """
+        class NotAServer:
+            def do_POST(self):
+                return 1
+
+        class LogHandler(logging.Handler):
+            def do_thing(self):
+                return 2
+        """
+    ) == []

@@ -19,6 +19,7 @@ The headline invariants under test:
 import json
 import os
 import signal
+import time
 
 import pytest
 
@@ -1045,3 +1046,79 @@ def test_spawn_ranks_propagate_cache_env(tmp_path, monkeypatch):
     # where the cache dir is already set
     assert captured["env"] is None
     assert os.environ["MPI_OPT_TPU_CACHE_DIR"] == cache
+
+
+# -- priority / deadline scheduling (ISSUE 16) ----------------------------
+
+
+def test_pick_next_priority_class_outranks_fair_share(tmp_path):
+    """A higher --priority job is picked first even when fair share
+    favors the other tenant (priority is a CLASS above the usage key,
+    not a tiebreak inside it)."""
+    spool = Spool(str(tmp_path))
+    lo = spool.submit(_quad(0), tenant="cheap", priority=0)
+    hi = spool.submit(_quad(1), tenant="busy", priority=5)
+    svc = _service(tmp_path)
+    svc._admit_pending()
+    svc._usage["busy"] = 50  # fair share alone would pick "cheap"
+    picked, lease, _ = svc._pick_next()
+    assert picked.job_id == hi and lease is not None
+    assert spool.tenant(lo).status["priority"] == 0
+    assert spool.tenant(hi).status["priority"] == 5
+
+
+def test_pick_next_earliest_deadline_orders_within_class(tmp_path):
+    """Inside one priority class, earliest deadline wins and
+    deadline-less jobs sort last — urgency and importance stay
+    independent axes."""
+    spool = Spool(str(tmp_path))
+    nodl = spool.submit(_quad(0), tenant="a")
+    late = spool.submit(_quad(1), tenant="b", deadline_ts=time.time() + 3600)
+    soon = spool.submit(_quad(2), tenant="c", deadline_ts=time.time() + 60)
+    svc = _service(tmp_path)
+    svc._admit_pending()
+    picked, _, _ = svc._pick_next()
+    assert picked.job_id == soon
+    st = spool.tenant(soon).status
+    assert st["deadline_ts"] == pytest.approx(
+        spool.tenant(soon).job["deadline_ts"]
+    )
+    assert spool.tenant(nodl).status["deadline_ts"] is None
+    assert spool.tenant(late).status["deadline_ts"] > st["deadline_ts"]
+
+
+def test_starvation_floor_promotes_a_waiting_job(tmp_path):
+    """A prio-0 job that has waited N floors gains N effective classes,
+    so a saturating high-priority stream delays it by a bounded number
+    of floors, never forever."""
+    spool = Spool(str(tmp_path))
+    old = spool.submit(_quad(0), tenant="patient", priority=0)
+    fresh = spool.submit(_quad(1), tenant="vip", priority=2)
+    svc = _service(tmp_path, starvation_floor_s=0.1)
+    svc._admit_pending()
+    t = spool.tenant(old)
+    t.write_status(dict(t.status, submitted_ts=time.time() - 1.0))
+    # waited ~10 floors: effective priority ~10 > the fresh job's 2
+    picked, _, _ = svc._pick_next()
+    assert picked.job_id == old
+    with pytest.raises(ValueError):
+        _service(tmp_path, starvation_floor_s=0.0)
+
+
+def test_submit_cli_priority_deadline_surfaced_in_status(tmp_path, capsys):
+    d = str(tmp_path)
+    assert service_main(
+        ["submit", "--state-dir", d, "--priority", "3", "--deadline", "120",
+         "--"] + _quad(0)
+    ) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["priority"] == 3
+    assert out["deadline_ts"] == pytest.approx(time.time() + 120, abs=30)
+    assert service_main(["status", "--state-dir", d, "--json"]) == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["jobs"][0]["priority"] == 3
+    assert st["jobs"][0]["deadline_ts"] == pytest.approx(out["deadline_ts"])
+    # the text rendering names both (the operator's at-a-glance view)
+    assert service_main(["status", "--state-dir", d]) == 0
+    text = capsys.readouterr().out
+    assert "prio=3" in text and "deadline=" in text
