@@ -39,6 +39,10 @@ class Observation:
     unit: np.ndarray
     score: float
     budget: int = 0
+    #: optional raw objective vector (ISSUE 17): present when the prior
+    #: record journaled multi-objective ``scores``; ``score`` stays the
+    #: scalarized authoritative value every scalar consumer ranks by
+    scores: tuple = None
 
 
 def best_finite(items, key):

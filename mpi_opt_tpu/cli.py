@@ -309,6 +309,21 @@ def build_parser() -> argparse.ArgumentParser:
         "--wave-size against the measured device budget. Resident-mode "
         "and post-budget OOMs exit 74 (classified, non-retryable)",
     )
+    p.add_argument(
+        "--objectives",
+        default=None,
+        metavar="SPEC",
+        help="fused pbt/asha: multi-objective search, e.g. "
+        '"accuracy:max,params:min<=2e4" — comma-separated '
+        "name:direction terms, each optionally constrained (<= for min, "
+        ">= for max). Boundary selection runs on the Pareto front "
+        "(non-dominated sort + crowding) inside the compiled boundary "
+        "op; constrained sweeps pick the best FEASIBLE member, "
+        "degrading (typed, never a crash) to the least-violating one "
+        "when nothing is feasible. The ledger journals each member's "
+        "objective vector beside the scalarized primary score; see "
+        "README 'Multi-objective search'",
+    )
     # multi-host bring-up (SURVEY.md §2 row 1 + §5): the reference's
     # ``mpirun`` launch WAS its user surface; the CLI owns SPMD bring-up
     # the same way — one OS process per host, each invoking this CLI
@@ -783,6 +798,17 @@ def run_fused(args, parser, workload) -> int:
 
     if not isinstance(workload, PopulationWorkload):
         parser.error(f"--fused requires a population workload, not {args.workload!r}")
+    # getattr: main() parsed --objectives; direct in-process callers
+    # (tests) may hand a namespace without it
+    objectives = getattr(args, "objective_spec", None)
+    if objectives is not None:
+        supported = tuple(workload.objective_metrics())
+        missing = [n for n in objectives.names if n not in supported]
+        if missing:
+            parser.error(
+                f"--objectives: workload {args.workload!r} cannot evaluate "
+                f"{missing}; supported metrics: {list(supported)}"
+            )
     if args.retries:
         import jax
 
@@ -857,7 +883,16 @@ def run_fused(args, parser, workload) -> int:
         # any driver's XLA RESOURCE_EXHAUSTED arrives here as ONE type
         with resources.oom_funnel():
             return _run_fused_dispatch(
-                args, parser, workload, mesh, n_chips, metrics, t0, ledger, warm_obs
+                args,
+                parser,
+                workload,
+                mesh,
+                n_chips,
+                metrics,
+                t0,
+                ledger,
+                warm_obs,
+                objectives=objectives,
             )
     except resources.DeviceOOM as e:
         # deterministic for this program+population: retrying the same
@@ -966,6 +1001,13 @@ def _open_fused_ledger(args, parser, space, metrics):
         "space_hash": space.space_hash(),
         "warm_start": args.warm_start,
     }
+    objectives = getattr(args, "objective_spec", None)
+    if objectives is not None:
+        # objective identity (names + directions + bounds) IS config:
+        # resuming a ledger under different objectives would journal a
+        # different selection trajectory. Scalar sweeps never write the
+        # key, so every pre-existing ledger keeps resuming byte-for-byte
+        config["objectives"] = args.objectives
     # the knobs that shape each algorithm's boundary/member structure
     if args.algorithm == "pbt":
         # wave_size is deliberately NOT ledger identity: wave scheduling
@@ -994,8 +1036,14 @@ def _open_fused_ledger(args, parser, space, metrics):
         config.update(max_budget=args.max_budget, eta=args.eta)
     try:
         # space_spec rides the header top-level (not identity): the
-        # corpus index fuzzy-fingerprints ledgers from it
-        ledger.ensure_header(config, space_spec=space.spec())
+        # corpus index fuzzy-fingerprints ledgers from it, and
+        # objective_spec (ISSUE 17) rides the same way so report/corpus
+        # consumers render fronts without re-parsing the config string
+        ledger.ensure_header(
+            config,
+            space_spec=space.spec(),
+            objective_spec=None if objectives is None else objectives.spec(),
+        )
     except LedgerError as e:
         parser.error(f"--ledger: {e}")
     if ledger.n_torn:
@@ -1010,7 +1058,16 @@ def _open_fused_ledger(args, parser, space, metrics):
 
 
 def _run_fused_dispatch(
-    args, parser, workload, mesh, n_chips, metrics, t0, ledger=None, warm_obs=None
+    args,
+    parser,
+    workload,
+    mesh,
+    n_chips,
+    metrics,
+    t0,
+    ledger=None,
+    warm_obs=None,
+    objectives=None,
 ) -> int:
     """The fused algorithm dispatch + summary (run_fused's tail, split
     out so the graceful-shutdown catch wraps every fused path)."""
@@ -1041,6 +1098,7 @@ def _run_fused_dispatch(
                 ledger=ledger,
                 warm_obs=warm_obs,
                 oom_backoff=args.oom_backoff,
+                objectives=objectives,
             ), args.retries, metrics)
             n_trials = args.population * args.generations
             extra = {"best_curve": [round(float(v), 4) for v in res["best_curve"]]}
@@ -1077,6 +1135,7 @@ def _run_fused_dispatch(
                 checkpoint_dir=args.checkpoint_dir,
                 ledger=ledger,
                 warm_obs=warm_obs,
+                objectives=objectives,
             ), args.retries, metrics)
             n_trials = res["n_trials"]
             extra = {"rung_sizes": res["rung_sizes"], "rung_budgets": res["rung_budgets"]}
@@ -1177,6 +1236,30 @@ def _run_fused_dispatch(
     if res.get("journal") is not None:
         metrics.count_journaled(res["journal"]["written"])
         summary["journal"] = dict(res["journal"])
+    # multi-objective extras (ISSUE 17): the final front + how the
+    # winner was picked. A constrained sweep that found nothing feasible
+    # reports selection="least_violation" AND emits the typed
+    # objective_degraded event — degradation is an outcome operators
+    # page on, never a silent argmax
+    if objectives is not None:
+        summary["objectives"] = res.get("objectives")
+        pareto = res.get("pareto")
+        summary["pareto"] = pareto
+        if pareto is not None:
+            metrics.log(
+                "pareto_front",
+                front_size=pareto["front_size"],
+                hypervolume=pareto["hypervolume"],
+                selection=pareto["selection"],
+                objectives=",".join(objectives.names),
+            )
+            if pareto["selection"] != "feasible":
+                metrics.log(
+                    "objective_degraded",
+                    selection=pareto["selection"],
+                    violation=pareto["violation"],
+                    objectives=",".join(objectives.names),
+                )
     metrics.summary(
         final=True,
         member_failures=(
@@ -1428,6 +1511,33 @@ def main(argv=None, *, _workload=None) -> int:
                 "waves; combining it with --gen-chunk/--step-chunk "
                 "launch splitting is ambiguous"
             )
+    # --objectives: parse + cross-validate as a usage error (exit 2),
+    # not a ValueError deep in the fused driver. The parsed spec rides
+    # args.objective_spec for run_fused's ledger/dispatch wiring.
+    args.objective_spec = None
+    if args.objectives:
+        if not args.fused or args.algorithm not in ("pbt", "asha"):
+            parser.error(
+                "--objectives runs multi-objective selection inside the "
+                "fused boundary ops; it requires --fused --algorithm "
+                "pbt|asha"
+            )
+        if args.wave_size:
+            parser.error(
+                "--objectives is not supported with --wave-size yet; run "
+                "resident (--wave-size 0) or shard over a mesh"
+            )
+        if args.step_chunk > 0:
+            parser.error(
+                "--objectives is not supported with --step-chunk (the "
+                "sub-segment boundary program is scalar); use --gen-chunk"
+            )
+        from mpi_opt_tpu.objectives import ObjectiveSpec
+
+        try:
+            args.objective_spec = ObjectiveSpec.parse(args.objectives)
+        except ValueError as e:
+            parser.error(f"--objectives: {e}")
     # --profile-launches: parse + validate as a usage error, and carry
     # the parsed window on args for the profile_window call sites
     args.profile_window = None

@@ -131,7 +131,40 @@ def summarize_entry_with_records(path: str) -> tuple:
             else fingerprint_from_records(ok)
         ),
     )
+    ospec = header.get("objective_spec")
+    if ospec:
+        # multi-objective sweeps (ISSUE 17) summarize their final
+        # non-dominated front so auto warm-start can rank MO priors
+        # (and resolve can seed from the front) without re-reading the
+        # ledger; a malformed spec degrades to None, never a crash
+        entry["pareto"] = _pareto_entry(ospec, records)
     return entry, records
+
+
+def _pareto_entry(ospec, records) -> Optional[dict]:
+    """Front size/objectives/hypervolume of an MO ledger's final state
+    (see ``ledger/report._mo_final_rows`` for the end-state rule)."""
+    import numpy as np
+
+    from mpi_opt_tpu.ledger.report import _mo_final_rows
+    from mpi_opt_tpu.objectives import (
+        ObjectiveSpec,
+        hypervolume,
+        pareto_front_mask,
+    )
+
+    try:
+        spec = ObjectiveSpec.from_spec(ospec)
+    except (ValueError, TypeError, KeyError):
+        return None
+    _recs, mat = _mo_final_rows(records, spec)
+    norm = np.asarray(spec.normalize(mat), dtype=np.float64)
+    mask = pareto_front_mask(norm)
+    return {
+        "objectives": [o.get("name") for o in ospec],
+        "front_size": int(mask.sum()),
+        "hypervolume": float(hypervolume(norm[mask])) if mask.any() else 0.0,
+    }
 
 
 def build_index(corpus_dir: str, prior: Optional[dict] = None) -> dict:

@@ -32,8 +32,37 @@ from typing import Optional
 
 from mpi_opt_tpu.corpus import index as cindex
 from mpi_opt_tpu.corpus.match import MIN_COMPAT, compat_score, fuzzy_observations
-from mpi_opt_tpu.ledger.store import LedgerError, read_ledger
+from mpi_opt_tpu.ledger.store import LedgerError, read_ledger, sniff_header
 from mpi_opt_tpu.ledger.warmstart import observations_from_records
+
+
+def _front_only(path: str, records) -> tuple:
+    """A multi-objective ledger's records reduced to its final
+    non-dominated set: ``(records, n_dominated)``.
+
+    Seeding a new sweep from an MO prior's DOMINATED points would pull
+    it toward trade-offs the prior already proved inferior, so only the
+    front enters the merge; scalar ledgers (no ``objective_spec`` in
+    the header) pass through untouched. A malformed spec also passes
+    through — degraded evidence beats refused evidence here, the same
+    rule as every other corpus degradation."""
+    header = sniff_header(path)
+    ospec = None if header is None else header.get("objective_spec")
+    if not ospec:
+        return records, 0
+    import numpy as np
+
+    from mpi_opt_tpu.ledger.report import _mo_final_rows
+    from mpi_opt_tpu.objectives import ObjectiveSpec, pareto_front_mask
+
+    try:
+        spec = ObjectiveSpec.from_spec(ospec)
+    except (ValueError, TypeError, KeyError):
+        return records, 0
+    recs, mat = _mo_final_rows(records, spec)
+    mask = pareto_front_mask(np.asarray(spec.normalize(mat), dtype=np.float64))
+    front = [recs[i] for i in np.flatnonzero(mask)]
+    return front, len(records) - len(front)
 
 
 @dataclasses.dataclass
@@ -161,6 +190,9 @@ def resolve(
         except (LedgerError, OSError) as e:
             skip(entry["path"], f"unreadable: {type(e).__name__}: {e}")
             continue
+        records, n_dom = _front_only(entry["path"], records)
+        if n_dom:
+            skips["dominated"] = skips.get("dominated", 0) + n_dom
         n = 0
         for rec in records:
             if rec["status"] != "ok" or rec.get("score") is None:
@@ -205,6 +237,9 @@ def resolve(
         except (LedgerError, OSError) as e:
             skip(entry["path"], f"unreadable: {type(e).__name__}: {e}")
             continue
+        records, n_dom = _front_only(entry["path"], records)
+        if n_dom:
+            skips["dominated"] = skips.get("dominated", 0) + n_dom
         obs, n_skipped = fuzzy_observations(space, records)
         if not obs:
             skip(entry["path"], "fuzzy: no record encodable into the live space")

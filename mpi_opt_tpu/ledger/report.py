@@ -111,11 +111,142 @@ def _service_status(path: str) -> Optional[dict]:
     return out
 
 
-def summarize_ledger(path: str) -> dict:
+def _mo_final_rows(records, spec):
+    """Each member's LAST full-vector ok record: ``(recs, matrix)``.
+
+    The Pareto view a report renders is the END state of the sweep —
+    one point per member/trial at its highest journaled budget (PBT
+    members re-evaluate every generation; SHA trials stop at different
+    rungs). Records missing the vector (scalar sweeps never carry one)
+    or holding a null entry (non-finite objective) never join the
+    front."""
+    import numpy as np
+
+    last: dict = {}
+    for r in records:
+        v = r.get("scores")
+        if (
+            r["status"] != "ok"
+            or v is None
+            or len(v) != spec.m
+            or any(x is None for x in v)
+        ):
+            continue
+        key = r.get("member", r["trial_id"])
+        cur = last.get(key)
+        if cur is None or (r["step"], r["trial_id"]) >= (cur["step"], cur["trial_id"]):
+            last[key] = r
+    recs = [last[k] for k in sorted(last)]
+    mat = np.asarray(
+        [[float(x) for x in r["scores"]] for r in recs], dtype=np.float64
+    ).reshape(len(recs), spec.m)
+    return recs, mat
+
+
+def _constrained_spec(spec, constraint: str):
+    """``spec`` with one bound overridden from a ``--best-under`` string
+    (``"params<=2e4"``). Raises LedgerError on an unknown objective or
+    an operator that disagrees with its direction."""
+    from mpi_opt_tpu.objectives import Objective, parse_constraint
+
+    try:
+        name, op, value = parse_constraint(constraint)
+    except ValueError as e:
+        raise LedgerError(str(e))
+    if name not in spec.names:
+        raise LedgerError(
+            f"--best-under names {name!r} but this sweep's objectives are "
+            f"{list(spec.names)}"
+        )
+    objs = []
+    for o in spec.objectives:
+        if o.name != name:
+            objs.append(o)
+            continue
+        want = ">=" if o.direction == "max" else "<="
+        if op != want:
+            raise LedgerError(
+                f"--best-under {constraint!r}: objective {name!r} is "
+                f"{o.direction}imized, so its constraint must use {want!r}"
+            )
+        objs.append(Objective(name, o.direction, float(value)))
+    from mpi_opt_tpu.objectives import ObjectiveSpec
+
+    return ObjectiveSpec(tuple(objs))
+
+
+def _mo_summary(header: dict, records, best_under: Optional[str]) -> Optional[dict]:
+    """The multi-objective block of a report (None when the header
+    carries no ``objective_spec``): final-state Pareto front, exact
+    hypervolume, and — when asked — the typed ``--best-under`` answer
+    (feasible / least_violation / diverged, never a crash)."""
+    ospec = header.get("objective_spec")
+    if not ospec:
+        if best_under:
+            raise LedgerError(
+                "--best-under needs a multi-objective ledger (no "
+                "objective_spec in header)"
+            )
+        return None
+    import numpy as np
+
+    from mpi_opt_tpu.objectives import (
+        ObjectiveSpec,
+        hypervolume,
+        pareto_front_mask,
+        select_best,
+    )
+
+    try:
+        spec = ObjectiveSpec.from_spec(ospec)
+    except (ValueError, TypeError, KeyError) as e:
+        raise LedgerError(f"malformed objective_spec in header: {e}")
+    recs, mat = _mo_final_rows(records, spec)
+    norm = np.asarray(spec.normalize(mat), dtype=np.float64)
+    mask = pareto_front_mask(norm)
+    idx = np.flatnonzero(mask)
+    out = {
+        "objectives": ospec,
+        "evaluated": len(recs),
+        "front_size": int(mask.sum()),
+        "front": [
+            {
+                "trial_id": recs[i]["trial_id"],
+                "member": recs[i].get("member"),
+                "step": recs[i]["step"],
+                "scores": [float(v) for v in mat[i]],
+                "params": recs[i]["params"],
+            }
+            for i in idx
+        ],
+        "hypervolume": float(hypervolume(norm[mask])) if len(idx) else 0.0,
+    }
+    if best_under:
+        cspec = _constrained_spec(spec, best_under)
+        sel = select_best(mat, cspec) if len(recs) else {
+            "index": None, "kind": "diverged", "violation": None,
+        }
+        picked = None if sel["index"] is None else recs[int(sel["index"])]
+        out["best_under"] = {
+            "constraint": best_under,
+            "kind": sel["kind"],
+            "violation": sel["violation"],
+            "trial_id": None if picked is None else picked["trial_id"],
+            "scores": None
+            if picked is None
+            else [float(v) for v in picked["scores"]],
+            "params": None if picked is None else picked["params"],
+        }
+    return out
+
+
+def summarize_ledger(path: str, best_under: Optional[str] = None) -> dict:
     """One ledger -> its machine-readable report dict.
 
     Raises LedgerError for files the tolerant loader refuses (malformed
-    mid-file records, missing header).
+    mid-file records, missing header), and for a ``best_under``
+    constraint that cannot apply (scalar ledger, unknown objective,
+    operator against the objective's direction).
     """
     header, records, n_torn = read_ledger(path)
     if header is None:
@@ -186,6 +317,7 @@ def summarize_ledger(path: str) -> dict:
         "trials_per_sec": round(n / span, 4) if span > 0 else None,
         "eval_wall_s": round(wall_sum, 3),
         "fused": fused,
+        "multi_objective": _mo_summary(header, records, best_under),
         "service": _service_status(path),
     }
 
@@ -248,6 +380,47 @@ def _render_text(rep: dict) -> str:
                 f"  note: boundary {f['torn_boundary']} is torn (killed "
                 "mid-journal; --resume re-journals it)"
             )
+    if rep.get("multi_objective"):
+        m = rep["multi_objective"]
+        obj_s = ", ".join(
+            f"{o['name']}:{o['direction']}"
+            + (
+                ""
+                if o.get("bound") is None
+                else (">=" if o["direction"] == "max" else "<=") + str(o["bound"])
+            )
+            for o in m["objectives"]
+        )
+        lines.append(f"  objectives: {obj_s}")
+        lines.append(
+            f"  pareto: front {m['front_size']}/{m['evaluated']} evaluated, "
+            f"hypervolume {m['hypervolume']:.6g}"
+        )
+        for fr in m["front"][:8]:
+            lines.append(
+                f"    trial {fr['trial_id']} @ step {fr['step']}  "
+                f"scores {fr['scores']}"
+            )
+        if len(m["front"]) > 8:
+            lines.append(f"    ... ({len(m['front'])} front points total)")
+        if m.get("best_under"):
+            bu = m["best_under"]
+            if bu["trial_id"] is None:
+                lines.append(
+                    f"  best-under {bu['constraint']}: none (every evaluated "
+                    "trial diverged)"
+                )
+            else:
+                note = (
+                    ""
+                    if bu["kind"] == "feasible"
+                    else f" [DEGRADED: nothing feasible; least violation "
+                    f"{bu['violation']:.4g}]"
+                )
+                lines.append(
+                    f"  best-under {bu['constraint']}: trial {bu['trial_id']} "
+                    f"scores {bu['scores']}{note}"
+                )
     if rep["best"] is None:
         lines.append("  best: none (no ok trial recorded)")
     else:
@@ -350,6 +523,14 @@ def report_main(argv=None) -> int:
     )
     p.add_argument("--json", action="store_true", help="machine-readable output")
     p.add_argument(
+        "--best-under",
+        metavar="CONSTRAINT",
+        help="answer 'best trial subject to CONSTRAINT' over a "
+        "multi-objective ledger, e.g. \"params<=2e4\" — typed result: "
+        "feasible, or DEGRADED to the least-violating trial when nothing "
+        "satisfies it (never a crash)",
+    )
+    p.add_argument(
         "--validate",
         action="store_true",
         help="strict schema check only: exit 1 on any malformed record "
@@ -396,7 +577,7 @@ def report_main(argv=None) -> int:
     rc = rc_expand
     for path in args.ledgers:
         try:
-            reports.append(summarize_ledger(path))
+            reports.append(summarize_ledger(path, best_under=args.best_under))
         except (LedgerError, OSError) as e:
             print(f"{path}: {e}", file=sys.stderr)
             rc = 1

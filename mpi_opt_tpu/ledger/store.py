@@ -94,6 +94,29 @@ def _check_trial_record(rec: dict, lineno: int) -> None:
         raise LedgerError(f"line {lineno}: unknown status {rec['status']!r}")
     if rec["status"] == "ok" and not isinstance(rec.get("score"), (int, float)):
         raise LedgerError(f"line {lineno}: ok record without a numeric score")
+    if rec.get("scores") is not None:
+        # the optional multi-objective vector (ISSUE 17): absent on every
+        # scalar record forever; when present it must be a list of
+        # numbers — an ok record's objectives are all finite by the
+        # journaling rule, so null entries only belong on failed records
+        scores = rec["scores"]
+        if not isinstance(scores, list) or not scores:
+            raise LedgerError(
+                f"line {lineno}: 'scores' must be a non-empty list when present"
+            )
+        bad = [
+            s for s in scores
+            if isinstance(s, bool)  # JSON true/false is drift, not a score
+            or not (s is None or isinstance(s, (int, float)))
+        ]
+        if bad:
+            raise LedgerError(
+                f"line {lineno}: non-numeric entries in 'scores': {bad!r}"
+            )
+        if rec["status"] == "ok" and any(s is None for s in scores):
+            raise LedgerError(
+                f"line {lineno}: ok record with a null objective in 'scores'"
+            )
     if "boundary" in rec:
         fused_missing = [k for k in ("member", "boundary_size") if k not in rec]
         if fused_missing:
@@ -404,7 +427,7 @@ class SweepLedger:
     def sweep_id(self) -> Optional[str]:
         return None if self.header is None else self.header.get("sweep_id")
 
-    def ensure_header(self, config: dict, space_spec=None) -> None:
+    def ensure_header(self, config: dict, space_spec=None, objective_spec=None) -> None:
         """Write the header (fresh ledger) or verify it (existing one).
 
         ``config`` is the sweep's identity dict; on an existing ledger a
@@ -419,6 +442,14 @@ class SweepLedger:
         the hash in ``config`` already settles identity, and folding
         the spec into the checked dict would refuse every pre-upgrade
         ledger's resume over a key it never wrote.
+
+        ``objective_spec`` (``ObjectiveSpec.spec()``, ISSUE 17) follows
+        the same top-level pattern for multi-objective sweeps: the
+        report/corpus layers read it to interpret each record's
+        ``scores`` vector, while identity stays in ``config`` (the CLI
+        puts the objective names there, so resuming a multi-objective
+        ledger under different objectives is refused through the
+        ordinary config gate). Scalar sweeps never write the key.
         """
         if self.header is not None:
             stale = {
@@ -445,6 +476,8 @@ class SweepLedger:
         }
         if space_spec is not None:
             self.header["space_spec"] = space_spec
+        if objective_spec is not None:
+            self.header["objective_spec"] = objective_spec
         if not self.read_only:
             self._write_line(self.header)
 
@@ -545,6 +578,7 @@ class SweepLedger:
         canonical_params: dict,
         score,
         step: int,
+        scores=None,
     ) -> dict:
         """Journal one fused population member's boundary evaluation
         (``ledger/fused.py`` drives this); durable before returning.
@@ -553,11 +587,22 @@ class SweepLedger:
         fused trainers' member-failure tallies apply: a non-finite
         member score is the fused divergence failure, journaled as
         ``failed`` with a null score so JSON stays strict.
+
+        ``scores`` (optional raw objective vector, ISSUE 17): a
+        non-finite value in ANY objective makes the whole record
+        ``failed`` with null score/scores — the scalar ``score``
+        remains authoritative (it is the spec-scalarized value), the
+        vector rides beside it for the Pareto consumers. Scalar sweeps
+        never pass it, so their records carry no ``scores`` key at all
+        and stay byte-identical to pre-17 journaling.
         """
         if self.header is None:
             raise LedgerError("ledger has no header — call ensure_header first")
         score = float(score)
         finite = np.isfinite(score)
+        if scores is not None:
+            vec = [float(s) for s in scores]
+            finite = finite and all(np.isfinite(v) for v in vec)
         rec = {
             "kind": "trial",
             "sweep_id": self.sweep_id,
@@ -569,7 +614,13 @@ class SweepLedger:
             "status": "ok" if finite else "failed",
             "score": score if finite else None,
             "step": int(step),
-            "error": None if finite else "non-finite member score",
+            "error": None
+            if finite
+            else (
+                "non-finite member score"
+                if scores is None
+                else "non-finite member objective"
+            ),
             "attempts": 1,
             # member evaluations share one fused boundary program; no
             # per-member wall exists (the boundary's wall lives in the
@@ -578,6 +629,8 @@ class SweepLedger:
             "cached": False,
             "ts": round(time.time(), 4),
         }
+        if scores is not None:
+            rec["scores"] = vec if finite else None
         if not self.read_only:
             self._write_line(rec)
         self.records.append(rec)

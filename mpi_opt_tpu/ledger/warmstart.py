@@ -86,11 +86,19 @@ def observations_from_records(records, space) -> tuple[list, dict]:
         except LedgerError:
             skips["bad_choice"] += 1
             continue
+        vec = rec.get("scores")
         obs.append(
             Observation(
                 unit=space.params_to_unit(decoded),
                 score=float(rec["score"]),
                 budget=int(rec["step"]),
+                # the optional objective vector (ISSUE 17) rides along so
+                # Pareto-aware consumers (corpus front seeding, the
+                # all-finite guard below) can see it; None entries stay
+                # None — the guard treats them as non-finite
+                scores=None
+                if vec is None
+                else tuple(None if v is None else float(v) for v in vec),
             )
         )
     return obs, {k: v for k, v in skips.items() if v}
@@ -117,14 +125,32 @@ def load_observations(path: str, space) -> tuple[list, dict]:
     return observations_from_records(records, space)
 
 
+def observation_fully_finite(o) -> bool:
+    """True when every numeric fact of the observation is finite: the
+    scalar score AND — for multi-objective priors — every entry of its
+    ``scores`` vector. A NaN in ANY objective disqualifies the record
+    from seeding (ISSUE 17 satellite): the scalarized score of a
+    partially-diverged trial can look healthy while the trial itself is
+    exactly what a new sweep must not start at."""
+    import numpy as np
+
+    if not np.isfinite(o.score):
+        return False
+    if getattr(o, "scores", None) is not None:
+        return all(
+            v is not None and np.isfinite(v) for v in o.scores
+        )
+    return True
+
+
 def best_observation(observations) -> "Observation | None":
     """The highest FINITE-scored prior observation, or None — the point
     the sampler-family consumers (driver random/ASHA, fused cohort
-    seeding) start from. Non-finite priors never seed: a diverged prior
-    point is exactly what a new sweep must not start at."""
-    import numpy as np
-
-    finite = [o for o in observations if np.isfinite(o.score)]
+    seeding) start from. Non-finite priors never seed (see
+    ``observation_fully_finite`` for the vector-score generalization):
+    a diverged prior point is exactly what a new sweep must not start
+    at."""
+    finite = [o for o in observations if observation_fully_finite(o)]
     return max(finite, key=lambda o: o.score) if finite else None
 
 

@@ -1,0 +1,49 @@
+"""Multi-objective & constrained search (ISSUE 17).
+
+The scalar sweep engine answers "what is the best score"; production
+queries are vector-valued — "best accuracy under a params budget", "the
+accuracy/latency trade-off curve". This package is the whole subsystem
+in two modules:
+
+- :mod:`.spec` — :class:`ObjectiveSpec`: named objectives with
+  directions and optional constraint bounds, parsed from the CLI
+  (``--objectives "accuracy:max,params:min<=2e4"``), carried in the
+  ledger header beside ``space_spec``, and hashable so it rides fused
+  drivers as a static jit argument.
+- :mod:`.pareto` — the jit-safe non-dominated-sort kernels
+  (:func:`pareto_rank`, :func:`crowding_distance`,
+  :func:`pareto_score`) that generalize the fused boundary ops, plus
+  the host-side front/:func:`hypervolume` helpers the report and
+  corpus layers consume, and the constraint-aware
+  :func:`select_best` (best feasible, with typed degradation to the
+  least-violating member when nothing is feasible yet).
+
+Everything selection-shaped reduces to one rule: :func:`pareto_score`
+folds (feasibility, Pareto rank, crowding) into a single effective
+scalar whose descending order IS the multi-objective preference order,
+so every scalar selection site (PBT truncation-exploit, SHA rung cut,
+winner picks) generalizes by swapping the score vector it ranks — no
+new control flow, no host round-trip.
+"""
+
+from mpi_opt_tpu.objectives.pareto import (
+    crowding_distance,
+    hypervolume,
+    pareto_front_mask,
+    pareto_rank,
+    pareto_score,
+    select_best,
+)
+from mpi_opt_tpu.objectives.spec import Objective, ObjectiveSpec, parse_constraint
+
+__all__ = [
+    "Objective",
+    "ObjectiveSpec",
+    "parse_constraint",
+    "pareto_rank",
+    "crowding_distance",
+    "pareto_score",
+    "pareto_front_mask",
+    "hypervolume",
+    "select_best",
+]

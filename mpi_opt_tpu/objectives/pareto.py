@@ -1,0 +1,249 @@
+"""Pareto-front kernels: jit-safe ranking + host-side front/volume math.
+
+Three device kernels (plain ``jax.numpy``, static shapes, no host
+round-trip — they compile into the fused boundary ops the same way
+``rank_descending`` does):
+
+- :func:`pareto_rank` — exact non-dominated-sort front index. The
+  front number of a point equals the longest chain of dominators
+  ending at it, so ``n`` Bellman iterations over the O(n²) dominance
+  matrix (``lax.fori_loop``) produce the exact NSGA-II fronts without
+  any data-dependent control flow.
+- :func:`crowding_distance` — per-front crowding (normalized neighbor
+  gaps per objective, front boundaries → ``inf``), computed with one
+  composite (front-major, value) sort per objective.
+- :func:`pareto_score` — the effective scalar that generalizes every
+  scalar selection site: feasible points order by ``-rank`` then
+  crowding (squashed into ``[0, 0.5]`` so it never crosses a rank
+  boundary), infeasible-but-finite points sit strictly below every
+  feasible one ordered by least constraint violation (the typed
+  degradation rule, computed inside jit), and non-finite points are
+  ``-inf``. ``rank_descending(pareto_score(...))`` IS multi-objective
+  selection.
+
+Host-side (numpy, report/corpus/summary consumers):
+:func:`pareto_front_mask`, :func:`hypervolume` (exact recursive
+slicing, deterministic reference point = per-objective front minimum),
+and :func:`select_best` (typed best-feasible winner pick).
+
+All kernels work in maximize form (see :mod:`.spec`); population sizes
+here are sweep populations (tens to a few hundred), so the O(n²·m)
+dominance matrix is trivially small next to one train segment.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "pareto_rank",
+    "crowding_distance",
+    "pareto_score",
+    "pareto_front_mask",
+    "hypervolume",
+    "select_best",
+]
+
+
+def _ok_mask(norm_scores, valid):
+    finite = jnp.all(jnp.isfinite(norm_scores), axis=-1)
+    return finite if valid is None else finite & jnp.asarray(valid)
+
+
+def pareto_rank(norm_scores, valid=None):
+    """Exact non-dominated-sort front index per row (0 = Pareto front).
+
+    ``norm_scores``: ``[n, m]`` maximize-form scores. Rows that are
+    non-finite in any objective (or masked by ``valid``) get rank
+    ``n`` — strictly after every real front.
+    """
+    n = norm_scores.shape[0]
+    ok = _ok_mask(norm_scores, valid)
+    s = jnp.where(ok[:, None], norm_scores.astype(jnp.float32), -jnp.inf)
+    # dom[j, i]: j dominates i (>= everywhere, > somewhere, both alive)
+    ge = jnp.all(s[:, None, :] >= s[None, :, :], axis=-1)
+    gt = jnp.any(s[:, None, :] > s[None, :, :], axis=-1)
+    dom = ge & gt & ok[:, None] & ok[None, :]
+
+    def body(_, r):
+        best = jnp.max(jnp.where(dom, r[:, None] + 1, 0), axis=0)
+        return jnp.maximum(r, best)
+
+    rank = jax.lax.fori_loop(0, n, body, jnp.zeros((n,), jnp.int32))
+    return jnp.where(ok, rank, n)
+
+
+def crowding_distance(norm_scores, rank, valid=None):
+    """NSGA-II crowding distance within each front (higher = lonelier).
+
+    Per objective the values are min-max normalized over live rows,
+    then each row's gap to its two same-front neighbors is summed;
+    front-boundary rows (and invalid rows) are ``inf``. One
+    ``argsort`` per objective on a composite (front, value) key keeps
+    fronts contiguous without data-dependent shapes.
+    """
+    n, m = norm_scores.shape
+    ok = _ok_mask(norm_scores, valid)
+    s = norm_scores.astype(jnp.float32)
+    rr = rank.astype(jnp.float32)
+    lo = jnp.min(jnp.where(ok[:, None], s, jnp.inf), axis=0)
+    hi = jnp.max(jnp.where(ok[:, None], s, -jnp.inf), axis=0)
+    span = jnp.maximum(hi - lo, 1e-12)
+    d = jnp.zeros((n,), jnp.float32)
+    for j in range(m):  # m is static
+        vn = jnp.where(ok, (s[:, j] - lo[j]) / span[j], 0.0)
+        order = jnp.argsort(rr * 2.0 + vn)  # vn ∈ [0,1] < front stride 2
+        r_s = rank[order]
+        v_s = vn[order]
+        prev_same = jnp.concatenate(
+            [jnp.zeros((1,), bool), r_s[1:] == r_s[:-1]]
+        )
+        next_same = jnp.concatenate(
+            [r_s[:-1] == r_s[1:], jnp.zeros((1,), bool)]
+        )
+        prev_v = jnp.concatenate([v_s[:1], v_s[:-1]])
+        next_v = jnp.concatenate([v_s[1:], v_s[-1:]])
+        gap = jnp.where(prev_same & next_same, next_v - prev_v, jnp.inf)
+        d = d + jnp.zeros((n,), jnp.float32).at[order].set(gap)
+    return jnp.where(ok, d, jnp.inf)
+
+
+def _violation(norm_scores, norm_bounds):
+    """Summed scale-normalized constraint violation per row (0 when
+    feasible). Unconstrained objectives carry ``-inf`` bounds and
+    contribute nothing."""
+    b = jnp.asarray(norm_bounds, jnp.float32)
+    s = norm_scores.astype(jnp.float32)
+    scale = jnp.maximum(jnp.abs(b), 1.0)
+    per = jnp.where(
+        jnp.isfinite(b)[None, :],
+        jnp.maximum(b[None, :] - s, 0.0) / scale[None, :],
+        0.0,
+    )
+    return jnp.sum(per, axis=-1)
+
+
+def pareto_score(norm_scores, valid=None, norm_bounds=None):
+    """The effective selection scalar (see module docstring).
+
+    Descending order of the result is the multi-objective preference
+    order: feasible fronts first (crowding breaks ties inside a
+    front), then infeasible-but-finite rows by least violation — the
+    typed degradation when nothing is feasible yet — then ``-inf``
+    for diverged rows.
+    """
+    n = norm_scores.shape[0]
+    ok = _ok_mask(norm_scores, valid)
+    if norm_bounds is None:
+        feasible = ok
+        violation = jnp.zeros((n,), jnp.float32)
+    else:
+        b = jnp.asarray(norm_bounds, jnp.float32)
+        sane = jnp.where(ok[:, None], norm_scores.astype(jnp.float32), -jnp.inf)
+        feasible = ok & jnp.all(
+            jnp.where(jnp.isfinite(b)[None, :], sane >= b[None, :], True),
+            axis=-1,
+        )
+        violation = _violation(sane, b)
+    rank = pareto_rank(norm_scores, valid=feasible)
+    crowd = crowding_distance(norm_scores, rank, valid=feasible)
+    squash = jnp.where(jnp.isfinite(crowd), crowd / (1.0 + crowd), 1.0)
+    eff_feasible = -rank.astype(jnp.float32) + 0.5 * squash
+    # every feasible eff > -n; infeasible strictly below, by violation
+    eff_infeasible = -(n + 1.0) - violation
+    return jnp.where(
+        feasible, eff_feasible, jnp.where(ok, eff_infeasible, -jnp.inf)
+    )
+
+
+# -- host side (report / corpus / winner picks) ---------------------------
+
+
+def pareto_front_mask(norm_scores, valid=None) -> np.ndarray:
+    """Boolean mask of non-dominated rows (host numpy; rows non-finite
+    in any objective are never on the front)."""
+    s = np.asarray(norm_scores, dtype=np.float64)
+    if s.ndim != 2:
+        raise ValueError(f"expected [n, m] scores, got shape {s.shape}")
+    ok = np.all(np.isfinite(s), axis=-1)
+    if valid is not None:
+        ok = ok & np.asarray(valid, dtype=bool)
+    masked = np.where(ok[:, None], s, -np.inf)
+    ge = np.all(masked[:, None, :] >= masked[None, :, :], axis=-1)
+    gt = np.any(masked[:, None, :] > masked[None, :, :], axis=-1)
+    dom = ge & gt & ok[:, None] & ok[None, :]
+    return ok & ~np.any(dom, axis=0)
+
+
+def hypervolume(front, ref=None) -> float:
+    """Exact hypervolume of a maximize-form front (recursive slicing).
+
+    ``ref`` defaults to the per-objective minimum over the (finite)
+    front — deterministic, so the same front always reports the same
+    volume; boundary points then contribute zero in the dimension they
+    anchor, which is the usual convention for a self-referenced front.
+    """
+    pts = np.asarray(front, dtype=np.float64)
+    if pts.size == 0:
+        return 0.0
+    if pts.ndim != 2:
+        raise ValueError(f"expected [n, m] front, got shape {pts.shape}")
+    pts = pts[np.all(np.isfinite(pts), axis=-1)]
+    if len(pts) == 0:
+        return 0.0
+    ref = pts.min(axis=0) if ref is None else np.asarray(ref, dtype=np.float64)
+    pts = np.maximum(pts, ref)
+
+    def _hv(p: np.ndarray, r: np.ndarray) -> float:
+        if len(p) == 0:
+            return 0.0
+        if r.shape[0] == 1:
+            return float(max(0.0, p[:, 0].max() - r[0]))
+        p = p[np.argsort(-p[:, 0], kind="stable")]
+        vol = 0.0
+        for i in range(len(p)):
+            right = p[i + 1, 0] if i + 1 < len(p) else r[0]
+            width = p[i, 0] - right
+            if width > 0.0:
+                vol += width * _hv(p[: i + 1, 1:], r[1:])
+        return vol
+
+    return _hv(pts, ref)
+
+
+def select_best(scores, spec) -> dict:
+    """Constraint-aware winner pick over raw ``[n, m]`` scores (host).
+
+    Typed result: ``kind`` is ``"feasible"`` (best normalized-primary
+    among feasible rows), ``"least_violation"`` (nothing feasible yet —
+    degrade to the least-violating finite row, primary breaks ties), or
+    ``"diverged"`` (no finite row at all; ``index`` is None).
+    """
+    raw = np.asarray(scores, dtype=np.float64)
+    norm = np.asarray(spec.normalize(raw), dtype=np.float64)
+    primary = np.asarray(spec.scalarize(raw), dtype=np.float64)
+    ok = np.all(np.isfinite(norm), axis=-1)
+    if not np.any(ok):
+        return {"index": None, "kind": "diverged", "violation": None}
+    b = spec.norm_bounds()
+    sane = np.where(ok[:, None], norm, -np.inf)
+    feasible = ok & np.all(
+        np.where(np.isfinite(b)[None, :], sane >= b[None, :], True), axis=-1
+    )
+    if np.any(feasible):
+        idx = int(np.argmax(np.where(feasible, primary, -np.inf)))
+        return {"index": idx, "kind": "feasible", "violation": 0.0}
+    scale = np.maximum(np.abs(b), 1.0)
+    per = np.where(
+        np.isfinite(b)[None, :],
+        np.maximum(b[None, :] - sane, 0.0) / scale[None, :],
+        0.0,
+    )
+    viol = np.where(ok, per.sum(axis=-1), np.inf)
+    # least violation wins; primary breaks exact ties deterministically
+    best_v = viol.min()
+    tied = ok & np.isclose(viol, best_v, rtol=0.0, atol=0.0)
+    idx = int(np.argmax(np.where(tied, primary, -np.inf)))
+    return {"index": idx, "kind": "least_violation", "violation": float(viol[idx])}

@@ -806,6 +806,23 @@ def validate_bench_record(rec) -> list:
         problems.append(
             "'device_memory' must be null or {bytes_in_use, source, ...}"
         )
+    # the multi-objective summary (ISSUE 17, bench config 8) is OPTIONAL
+    # forever — every scalar record (including the committed history)
+    # stays valid without it — but a present 'scores' must be a
+    # {objective: number} object so the trajectory comparison can rely
+    # on its shape the same way it relies on trace/device_memory
+    sc = rec.get("scores")
+    if sc is not None and (
+        not isinstance(sc, dict)
+        or not sc
+        or not all(
+            isinstance(k, str)
+            and isinstance(v, (int, float))
+            and not isinstance(v, bool)
+            for k, v in sc.items()
+        )
+    ):
+        problems.append("'scores' must be null or a {objective: number} object")
     return problems
 
 

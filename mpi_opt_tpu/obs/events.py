@@ -85,6 +85,14 @@ EVENTS = frozenset(
         # lifecycle (serve start, one record per served request, the
         # stop/idle summary)
         "corpus_skip",
+        # multi-objective search (objectives/, ISSUE 17): pareto_front =
+        # a fused MO sweep's final non-dominated front (size,
+        # hypervolume, selection kind); objective_degraded = a
+        # constrained sweep found NOTHING feasible and typed-degraded
+        # its winner to the least-violating member — an outcome to page
+        # on, never a silent argmax
+        "pareto_front",
+        "objective_degraded",
         "suggest_serve",
         "suggest_request",
         "suggest_stop",
@@ -160,6 +168,7 @@ SPAN_ATTRS = frozenset(
         "flops",  # segment FLOPs for achieved TF/s (set at exit)
         # provenance
         "op",  # boundary/digest flavor (exploit/rung_cut/suggest/...)
+        "objectives",  # MO sweep: comma-joined objective names (train)
         "backend",  # driver setup backend name
         "workload",  # fused setup workload name
         "cache",  # compile: cold | persistent (listener)

@@ -53,6 +53,30 @@ def asha_cut(scores: jax.Array, eta: int, valid: jax.Array | None = None):
     return promote, order
 
 
+def asha_cut_mo(
+    norm_scores: jax.Array,  # float32[n, m] maximize-form objective matrix
+    eta: int,
+    valid: jax.Array | None = None,
+    norm_bounds=None,  # float32[m] maximize-form bounds, or None
+):
+    """Multi-objective rung cut: promote by Pareto rank, not scalar top-k.
+
+    The cohort is ranked by :func:`~mpi_opt_tpu.objectives.pareto.
+    pareto_score` (non-dominated front, then crowding distance, with
+    constraint-aware degradation below every feasible member) and the
+    same top-``ceil(n_valid/eta)`` rule as :func:`asha_cut` applies to
+    that effective scalar — one compiled reduction, no host
+    round-trip. Returns ``(promote, order, eff)`` where ``eff`` is the
+    effective ``float32[n]`` selection score (also the rung's
+    journaled-scalar tiebreak witness).
+    """
+    from mpi_opt_tpu.objectives.pareto import pareto_score
+
+    eff = pareto_score(norm_scores, valid=valid, norm_bounds=norm_bounds)
+    promote, order = asha_cut(eff, eta, valid)
+    return promote, order, eff
+
+
 def asha_top_k_dense(scores: jax.Array, k: int):
     """Static-k variant for fully-populated rungs: plain ``lax.top_k``."""
     vals, idx = lax.top_k(scores, k)

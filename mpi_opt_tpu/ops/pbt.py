@@ -56,6 +56,42 @@ def pbt_exploit_explore(
 
     Fully jittable; ``n``, ``d`` and ``cfg`` are static.
     """
+    return _exploit_explore(key, unit, scores, discrete_mask, cfg)
+
+
+def pbt_exploit_explore_mo(
+    key: jax.Array,
+    unit: jax.Array,  # float32[n, d]
+    norm_scores: jax.Array,  # float32[n, m] maximize-form objective matrix
+    discrete_mask: jax.Array,  # bool[d]
+    cfg: PBTConfig = PBTConfig(),
+    norm_bounds=None,  # float32[m] maximize-form bounds, or None
+):
+    """Multi-objective PBT decision: truncation-exploit by Pareto rank.
+
+    Identical mechanics to :func:`pbt_exploit_explore` — same key
+    splits, same truncation/perturb/resample ops — except the
+    population is ranked by :func:`~mpi_opt_tpu.objectives.pareto.
+    pareto_score` (non-dominated front, then crowding, with
+    constraint-aware degradation) instead of a scalar. Stays a single
+    compiled boundary op. Returns the scalar triple plus the effective
+    selection scores ``float32[n]`` for observability.
+    """
+    from mpi_opt_tpu.objectives.pareto import pareto_score
+
+    eff = pareto_score(norm_scores, norm_bounds=norm_bounds)
+    new_unit, src_idx, bottom = _exploit_explore(
+        key, unit, eff, discrete_mask, cfg
+    )
+    return new_unit, src_idx, bottom, eff
+
+
+def _exploit_explore(key, unit, scores, discrete_mask, cfg):
+    """Shared exploit/explore body; ``scores`` is whatever effective
+    scalar ranks the population (raw score, or a Pareto effective
+    score). The op sequence here is the PR-16 scalar sequence verbatim
+    — the scalar path's bit-identity (PERF_NOTES round 6) hangs on the
+    key-split order and op order not changing."""
     n, d = unit.shape
     k_src, k_noise, k_resample, k_resample_val = jax.random.split(key, 4)
 

@@ -258,6 +258,14 @@ class SearchSpace:
         unit rows. Dataclass fields capture each domain's full bounds;
         Choice options go through ``_plain`` so non-JSON option objects
         degrade to their repr deterministically.
+
+        Multi-objective sweeps (ISSUE 17) journal a sibling
+        ``objective_spec`` (objectives.ObjectiveSpec.spec — names,
+        directions, constraint bounds) in the same header, top-level
+        beside ``space_spec``: the space says WHERE the sweep searched,
+        the objective spec says WHAT it optimized. Both ride outside
+        the hashed config identity; objective identity enters identity
+        through the config's ``objectives`` string instead.
         """
         out = []
         for name, dom in self.domains.items():

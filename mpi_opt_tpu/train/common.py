@@ -135,7 +135,9 @@ def launch_boundary(stage: str, *, final: bool, snapshot=None, **progress) -> No
     raise shutdown.SweepInterrupted(shutdown.active_signal(), at=stage)
 
 
-def journal_boundary(journal, b_local: int, members, units, scores, step: int) -> None:
+def journal_boundary(
+    journal, b_local: int, members, units, scores, step: int, scores_mo=None
+) -> None:
     """The fused drivers' shared ledger service point, paired with
     ``launch_boundary``: called once per natural boundary (PBT
     generation, SHA/BOHB rung, TPE batch) with the boundary's member
@@ -143,14 +145,22 @@ def journal_boundary(journal, b_local: int, members, units, scores, step: int) -
     is saved, so the journal never lags the snapshot (the fused twin of
     the driver path's fsync-before-report invariant). No-op without a
     journal; on a re-computed boundary (resume) it verifies against the
-    journal instead of re-writing (ledger/fused.py)."""
+    journal instead of re-writing (ledger/fused.py).
+
+    ``scores_mo`` (optional ``[n, m]`` raw objective matrix) is the
+    multi-objective sweeps' vector payload: ``scores`` stays the
+    authoritative scalarized score (what resume/fsck/warm-start
+    verify), the vectors ride beside it as each record's ``scores``
+    field."""
     if journal is None:
         return
     # one journal span per boundary (not per member record: a pop-1024
     # generation journals 1024 fsync'd lines — span volume must stay
     # proportional to boundaries, not members)
     with trace.span("journal", boundary=int(b_local), n=len(members)):
-        journal.record_boundary(b_local, members, units, scores, step)
+        journal.record_boundary(
+            b_local, members, units, scores, step, scores_mo=scores_mo
+        )
 
 
 def journal_require_prefix(journal, n_boundaries: int) -> None:
@@ -168,6 +178,39 @@ def make_fused_journal(ledger, space, **offsets):
     from mpi_opt_tpu.ledger.fused import make_journal
 
     return make_journal(ledger, space, **offsets)
+
+
+#: objective metric names the population eval path can produce; the
+#: ObjectiveSpec names of a fused multi-objective sweep must come from
+#: this set (validated in the CLI before anything compiles)
+POPULATION_METRICS = ("accuracy", "params", "latency")
+
+
+def eval_population_objectives(trainer, state, val_x, val_y, names):
+    """Multi-metric population eval: raw ``float32[P, m]``, one column
+    per objective name (ISSUE 17).
+
+    Jit-safe with ``names`` static (it arrives from the frozen
+    ObjectiveSpec that is itself a static jit arg), so inside
+    ``run_fused_pbt`` this compiles into the generation scan; called
+    eagerly from the SHA rung loop it dispatches the same jitted
+    programs with no extra host sync — columns stay on device until
+    the driver's one per-boundary fetch.
+    """
+    cols = []
+    for name in names:
+        if name == "accuracy":
+            cols.append(trainer.eval_population(state, val_x, val_y))
+        elif name == "params":
+            cols.append(trainer.member_effective_params(state))
+        elif name == "latency":
+            cols.append(trainer.member_latency_proxy(state))
+        else:
+            raise ValueError(
+                f"unknown population objective {name!r}; "
+                f"supported: {POPULATION_METRICS}"
+            )
+    return jnp.stack(cols, axis=-1)
 
 
 def segment_flops_hint(workload, population: int, steps: int):

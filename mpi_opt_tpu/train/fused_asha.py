@@ -33,8 +33,9 @@ import jax
 import numpy as np
 
 from mpi_opt_tpu.obs import memory, trace
-from mpi_opt_tpu.ops.asha import asha_cut, asha_rungs
+from mpi_opt_tpu.ops.asha import asha_cut, asha_cut_mo, asha_rungs
 from mpi_opt_tpu.train.common import (
+    eval_population_objectives,
     finite_winner,
     journal_boundary,
     journal_require_prefix,
@@ -56,6 +57,18 @@ def _cut_and_gather(trainer, state, unit, scores, eta: int, k: int):
     Returns (survivor_state, survivor_unit, keep_idx, promote_mask).
     """
     promote, order = asha_cut(scores, eta)
+    keep = order[:k]
+    return trainer.gather_members(state, keep), unit[keep], keep, promote
+
+
+@functools.partial(jax.jit, static_argnames=("trainer", "eta", "k"))
+def _cut_and_gather_mo(trainer, state, unit, norm_scores, eta: int, k: int, norm_bounds=None):
+    """The rung reduction's multi-objective twin (ISSUE 17): rank by
+    ``pareto_score`` (front index, crowding tie-break, constraint
+    degradation) instead of the raw scalar, then keep/gather exactly as
+    the scalar cut does — the Pareto selection stays inside the same
+    compiled boundary program, no extra host round-trip."""
+    promote, order, _eff = asha_cut_mo(norm_scores, eta, norm_bounds=norm_bounds)
     keep = order[:k]
     return trainer.gather_members(state, keep), unit[keep], keep, promote
 
@@ -91,6 +104,7 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
     trial_offset: int = 0,
     member_offset: int = 0,
     warm_obs=None,
+    objectives=None,
 ):
     """Run a whole successive-halving sweep with on-device rung cuts.
 
@@ -121,12 +135,29 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
     arguments resumes at the next rung and — the key being part of the
     snapshot — produces the IDENTICAL result of an uninterrupted run.
     A config-mismatched checkpoint raises ValueError.
+
+    ``objectives`` (an ``ObjectiveSpec``, ISSUE 17) turns every rung cut
+    multi-objective: each rung evaluates the spec's metrics, cuts by
+    ``pareto_score`` inside the compiled boundary op, and journals the
+    scalarized primary score (authoritative) plus the raw objective
+    vector per record. The scalar path is untouched.
     """
     from mpi_opt_tpu.parallel.mesh import fetch_global, place_pop, shard_popstate
 
     trainer, space, train_x, train_y, val_x, val_y = workload_arrays(
         workload, member_chunk, mesh
     )
+    norm_bounds = None
+    if objectives is not None:
+        supported = tuple(workload.objective_metrics())
+        missing = [n for n in objectives.names if n not in supported]
+        if missing:
+            raise ValueError(
+                f"workload {getattr(workload, 'name', type(workload).__name__)!r} "
+                f"cannot evaluate objectives {missing}; supported: {supported}"
+            )
+        if objectives.has_bounds:
+            norm_bounds = objectives.norm_bounds()
     rungs = asha_rungs(min_budget, max_budget, eta)
     if mesh is not None and round_to == 1:
         round_to = mesh.shape["pop"]
@@ -160,29 +191,32 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
     if checkpoint_dir is not None:
         from mpi_opt_tpu.utils.checkpoint import SweepCheckpointer
 
-        snap = SweepCheckpointer(
-            checkpoint_dir,
-            {
-                "workload": getattr(workload, "name", type(workload).__name__),
-                "n_trials": n_trials,
-                "rungs": rungs,
-                "sizes": sizes,
-                "eta": eta,
-                "seed": seed,
-                "member_chunk": member_chunk,
-                # carried-state structure (see fused_pbt): a resumed rung
-                # must find momentum in the dtype it was saved with
-                "momentum_dtype": momentum_dtype_str(),
-                # the initial cohort defines the sweep: a resume whose
-                # caller supplies different configurations is a
-                # different search and must be refused
-                "init_unit_digest": (
-                    None
-                    if init_unit is None
-                    else hashlib.sha1(init_unit.tobytes()).hexdigest()
-                ),
-            },
-        )
+        ck_config = {
+            "workload": getattr(workload, "name", type(workload).__name__),
+            "n_trials": n_trials,
+            "rungs": rungs,
+            "sizes": sizes,
+            "eta": eta,
+            "seed": seed,
+            "member_chunk": member_chunk,
+            # carried-state structure (see fused_pbt): a resumed rung
+            # must find momentum in the dtype it was saved with
+            "momentum_dtype": momentum_dtype_str(),
+            # the initial cohort defines the sweep: a resume whose
+            # caller supplies different configurations is a
+            # different search and must be refused
+            "init_unit_digest": (
+                None
+                if init_unit is None
+                else hashlib.sha1(init_unit.tobytes()).hexdigest()
+            ),
+        }
+        if objectives is not None:
+            # objective identity shapes every cut (see fused_pbt); the
+            # key is absent on scalar sweeps so pre-existing snapshots
+            # keep resuming
+            ck_config["objectives"] = objectives.spec()
+        snap = SweepCheckpointer(checkpoint_dir, ck_config)
         restored = snap.restore_population_sweep()
         if restored is not None:
             state, unit, k_run, scores, meta = restored
@@ -252,6 +286,8 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
     defer = snap is None and journal is None
     rung_scores_dev: list = []  # device scores per rung (pre-cut rows)
     rung_keep_dev: list = []  # device survivor indices per cut
+    rung_mo_dev: list = []  # device [n, m] objective matrices (MO only)
+    np_final_mo = None  # last rung's raw objective matrix (MO only)
     try:
         for r in range(start_rung, len(rungs)):
             budget = rungs[r]
@@ -274,13 +310,29 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
                 members=sizes[r],
                 steps=budget - prev_budget,
             ) as sp:
+                if objectives is not None:
+                    # registered span attr: MO rungs are visible in the
+                    # trace; the cut still runs on-device (no new sync)
+                    sp["objectives"] = ",".join(objectives.names)
                 hp = workload.make_hparams(space.from_unit(unit))
                 state, _ = trainer.train_segment(
                     state, hp, train_x, train_y, k_seg, budget - prev_budget
                 )
-                scores = trainer.eval_population(state, val_x, val_y)
+                if objectives is None:
+                    mo = None
+                    scores = trainer.eval_population(state, val_x, val_y)
+                else:
+                    # each metric call is its own jitted program, so the
+                    # dispatches stay async — the rung still pays at most
+                    # the one host fetch the eager path always paid
+                    mo = eval_population_objectives(
+                        trainer, state, val_x, val_y, objectives.names
+                    )
+                    scores = objectives.scalarize(mo)
                 if defer:
                     rung_scores_dev.append(scores)
+                    if mo is not None:
+                        rung_mo_dev.append(mo)
                 else:
                     np_scores = fetch_global(scores)
                     # ...and attached only AFTER the fetch barrier: a
@@ -292,19 +344,32 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
                     # cohort + activations just peaked
                     memory.note(sp)
             if not defer:
+                np_mo = None if mo is None else fetch_global(mo)
+                np_final_mo = np_mo if np_mo is not None else np_final_mo
                 record_rung(r, np_scores)
                 if journal is not None:
                     # one member record per PRE-cut survivor at this
                     # rung's budget, before the rung snapshot below
                     journal_boundary(
                         journal, r, alive, fetch_global(unit), np_scores,
-                        step=budget,
+                        step=budget, scores_mo=np_mo,
                     )
             if r < len(rungs) - 1:
                 with trace.span("boundary", op="rung_cut", rung=r + 1):
-                    state, unit, keep, _ = _cut_and_gather(
-                        trainer, state, unit, scores, eta, sizes[r + 1]
-                    )
+                    if objectives is None:
+                        state, unit, keep, _ = _cut_and_gather(
+                            trainer, state, unit, scores, eta, sizes[r + 1]
+                        )
+                    else:
+                        state, unit, keep, _ = _cut_and_gather_mo(
+                            trainer,
+                            state,
+                            unit,
+                            objectives.normalize(mo),
+                            eta,
+                            sizes[r + 1],
+                            norm_bounds=norm_bounds,
+                        )
                     if mesh is not None:
                         # re-place: the gather may leave survivors
                         # unsharded/skewed
@@ -357,9 +422,12 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
         # did per rung
         from mpi_opt_tpu.parallel.mesh import fetch_global_batched
 
-        fetched = fetch_global_batched(rung_scores_dev + rung_keep_dev)
-        np_rung_scores = fetched[: len(rung_scores_dev)]
-        np_keeps = fetched[len(rung_scores_dev):]
+        fetched = fetch_global_batched(rung_scores_dev + rung_keep_dev + rung_mo_dev)
+        ns, nk = len(rung_scores_dev), len(rung_keep_dev)
+        np_rung_scores = fetched[:ns]
+        np_keeps = fetched[ns : ns + nk]
+        if rung_mo_dev:
+            np_final_mo = fetched[-1]  # last rung's objective matrix
         final_np_scores = np_rung_scores[-1]  # last rung has no cut
         for r_off, np_scores in enumerate(np_rung_scores):
             r = start_rung + r_off
@@ -375,6 +443,34 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
     # cohort reports non-finite/None with diverged=True, so no
     # arbitrary row masquerades as a meaningful winner
     best_row, diverged = finite_winner(final_scores)
+    pareto = None
+    if objectives is not None and np_final_mo is not None:
+        from mpi_opt_tpu.objectives import (
+            hypervolume,
+            pareto_front_mask,
+            select_best,
+        )
+
+        # constraint-aware winner (see fused_pbt): best FEASIBLE
+        # survivor, typed degradation to least-violating when nothing is
+        sel = select_best(np_final_mo, objectives)
+        if sel["index"] is None:
+            best_row, diverged = 0, True
+        else:
+            best_row, diverged = int(sel["index"]), False
+        norm = objectives.normalize(np_final_mo)
+        mask = pareto_front_mask(norm)
+        front_rows = [int(i) for i in np.flatnonzero(mask)]
+        pareto = {
+            "front_size": len(front_rows),
+            "front_members": [int(alive[i]) for i in front_rows],
+            "front_scores": [
+                [float(v) for v in np_final_mo[i]] for i in front_rows
+            ],
+            "hypervolume": float(hypervolume(norm[mask])) if front_rows else 0.0,
+            "selection": sel["kind"],
+            "violation": sel["violation"],
+        }
     return {
         # diverged normalizes to NaN (not a raw +/-inf row) so library
         # callers can detect it uniformly across fused SHA/PBT/TPE
@@ -401,6 +497,11 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
         "journal": None
         if journal is None
         else {"written": journal.written, "verified": journal.verified},
+        # multi-objective extras (ISSUE 17, see fused_pbt): None on
+        # scalar sweeps and on a resume that restarted past the final
+        # rung (``report`` recomputes the front from the ledger then)
+        "objectives": None if objectives is None else list(objectives.names),
+        "pareto": pareto,
     }
 
 

@@ -32,8 +32,9 @@ import jax
 import jax.numpy as jnp
 
 from mpi_opt_tpu.obs import memory, trace
-from mpi_opt_tpu.ops.pbt import PBTConfig, pbt_exploit_explore
+from mpi_opt_tpu.ops.pbt import PBTConfig, pbt_exploit_explore, pbt_exploit_explore_mo
 from mpi_opt_tpu.train.common import (
+    eval_population_objectives,
     finite_winner,
     journal_boundary,
     journal_require_prefix,
@@ -49,7 +50,10 @@ from mpi_opt_tpu.train.population import OptHParams, PopState, PopulationTrainer
 
 @functools.partial(
     jax.jit,
-    static_argnames=("trainer", "hparams_fn", "discrete_mask", "generations", "steps_per_gen", "cfg"),
+    static_argnames=(
+        "trainer", "hparams_fn", "discrete_mask", "generations",
+        "steps_per_gen", "cfg", "objectives",
+    ),
     donate_argnames=("state", "unit"),
 )
 def run_fused_pbt(
@@ -66,6 +70,7 @@ def run_fused_pbt(
     generations: int = 10,
     steps_per_gen: int = 100,
     cfg: PBTConfig = PBTConfig(),
+    objectives=None,  # static ObjectiveSpec: multi-objective exploit (ISSUE 17)
 ):
     """Returns (state, unit, key', best_curve[G], mean_curve[G],
     member_fail[G], final_scores[P], pre_scores[G, P], pre_units[G, P, d]).
@@ -85,16 +90,55 @@ def run_fused_pbt(
     the chain — feeding it into a following call continues the EXACT
     trajectory one longer call would have taken, which is what makes
     ``gen_chunk`` launch-splitting bit-identical to a single launch.
+
+    ``objectives`` (a static, hashable ``ObjectiveSpec``) switches the
+    generation boundary to multi-objective selection: each generation
+    evaluates the full objective matrix on device
+    (``eval_population_objectives``), the exploit ranks by Pareto
+    score inside the same compiled scan (``pbt_exploit_explore_mo`` —
+    no host round-trip is added to the hot path), and the scan's
+    scalar outputs carry the spec-scalarized primary objective so
+    every scalar consumer (curves, journaling, snapshots) works
+    unchanged. The return grows two trailing outputs:
+    ``pre_mo[G, P, m]`` (raw pre-exploit objective matrices — the
+    ledger's ``scores`` vectors) and ``final_mo[P, m]`` (the final
+    post-exploit population's objectives, for the winner pick /
+    front summary). Scalar calls return the original 9-tuple.
     """
     if generations < 1:  # static arg: raises at trace time, not opaquely later
         raise ValueError(f"generations must be >= 1, got {generations}")
     disc = jnp.asarray(discrete_mask, dtype=bool)
+    norm_bounds = (
+        objectives.norm_bounds()
+        if objectives is not None and objectives.has_bounds
+        else None
+    )
 
     def one_generation(carry, g):
         st, u, k = carry
         k, k_train, k_pbt = jax.random.split(k, 3)
         hp = hparams_fn(u)
         st, _ = trainer.train_segment(st, hp, train_x, train_y, k_train, steps_per_gen)
+        if objectives is not None:
+            mo = eval_population_objectives(
+                trainer, st, val_x, val_y, objectives.names
+            )
+            scores = objectives.scalarize(mo)
+            new_u, src_idx, _, _eff = pbt_exploit_explore_mo(
+                k_pbt,
+                u,
+                objectives.normalize(mo),
+                disc,
+                cfg,
+                norm_bounds=norm_bounds,
+            )
+            st = trainer.gather_members(st, src_idx)
+            # a non-finite value in ANY objective is the member failure
+            n_fail = jnp.sum(~jnp.all(jnp.isfinite(mo), axis=-1)).astype(jnp.int32)
+            return (st, new_u, k), (
+                scores.max(), scores.mean(), n_fail, scores[src_idx],
+                scores, u, mo, mo[src_idx],
+            )
         scores = trainer.eval_population(st, val_x, val_y)
         new_u, src_idx, _ = pbt_exploit_explore(k_pbt, u, scores, disc, cfg)
         st = trainer.gather_members(st, src_idx)
@@ -104,6 +148,15 @@ def run_fused_pbt(
         n_fail = jnp.sum(~jnp.isfinite(scores)).astype(jnp.int32)
         return (st, new_u, k), (
             scores.max(), scores.mean(), n_fail, scores[src_idx], scores, u,
+        )
+
+    if objectives is not None:
+        (state, unit, key), (
+            best, mean, fails, gen_scores, pre_scores, pre_units, pre_mo, gen_mo
+        ) = jax.lax.scan(one_generation, (state, unit, key), jnp.arange(generations))
+        return (
+            state, unit, key, best, mean, fails, gen_scores[-1],
+            pre_scores, pre_units, pre_mo, gen_mo[-1],
         )
 
     (state, unit, key), (best, mean, fails, gen_scores, pre_scores, pre_units) = (
@@ -868,9 +921,21 @@ def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, expl
     ledger=None,
     warm_obs=None,
     oom_backoff: int = 2,
+    objectives=None,
 ):
     """Convenience wrapper: run a whole PBT sweep for a vision-style
     workload; optionally sharded over a ``('pop','data')`` mesh.
+
+    ``objectives`` (an ``ObjectiveSpec``, ISSUE 17) runs the sweep
+    multi-objective: the exploit selects by Pareto rank + crowding
+    inside the compiled generation scan, records journal raw objective
+    vectors beside their scalarized score, and the result carries the
+    final population's Pareto front + hypervolume with a
+    constraint-aware winner (typed ``selection``: feasible /
+    least_violation / diverged). Resident + ``gen_chunk`` only — wave
+    scheduling and ``step_chunk`` refuse (their boundary programs are
+    scalar), and the objective names must come from the workload's
+    ``objective_metrics()``.
 
     ``oom_backoff`` (wave mode; ISSUE 13): budget of automatic
     wave-size halvings on a device OOM — each absorbed OOM re-runs its
@@ -953,6 +1018,25 @@ def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, expl
             "step_chunk splits within generations; combining it with "
             f"gen_chunk={gen_chunk} (grouping whole generations) is ambiguous"
         )
+    if objectives is not None:
+        if step_chunk > 0:
+            raise ValueError(
+                "step_chunk is not supported with multi-objective sweeps "
+                "(the sub-segment boundary program is scalar); use gen_chunk"
+            )
+        if wave_size:
+            raise ValueError(
+                "wave scheduling is not supported with multi-objective "
+                "sweeps yet; run resident (wave_size=0) or shard the "
+                "population over a mesh"
+            )
+        supported = tuple(workload.objective_metrics())
+        missing = [n for n in objectives.names if n not in supported]
+        if missing:
+            raise ValueError(
+                f"workload {getattr(workload, 'name', '?')!r} cannot "
+                f"evaluate objectives {missing}; supported: {supported}"
+            )
     trainer, space, train_x, train_y, val_x, val_y = workload_arrays(
         workload, member_chunk, mesh
     )
@@ -1059,39 +1143,46 @@ def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, expl
     launch_walls: list = []  # seconds per completed launch (excl. snapshot saves)
     walls_complete = True  # False when resuming a pre-duration-recording snapshot
     scores = None
+    # final [P, m] raw objective matrix (MO only); None until a launch of
+    # THIS process completes — a resume that starts past the last launch
+    # leaves it None and the Pareto summary falls back to the ledger
+    np_final_mo = None
     if checkpoint_dir is not None:
         import dataclasses
 
         from mpi_opt_tpu.utils.checkpoint import SweepCheckpointer
 
-        snap = SweepCheckpointer(
-            checkpoint_dir,
-            {
-                "workload": getattr(workload, "name", type(workload).__name__),
-                "population": population,
-                "generations": generations,
-                "steps_per_gen": steps_per_gen,
-                "seed": seed,
-                "launch_lens": launch_lens,
-                "member_chunk": member_chunk,
-                # PBT knobs change exploit/explore behavior: resuming under
-                # a different cfg would not be the continuation we promise
-                "cfg": dataclasses.asdict(cfg),
-                # step_chunk changes the RNG derivation (folded sub-segment
-                # keys), i.e. the trajectory itself — not just the launch
-                # split the way gen_chunk does
-                "step_chunk": step_chunk,
-                # the momentum STORAGE dtype is part of the carried state's
-                # structure: resuming a bf16-momentum snapshot into an f32
-                # trainer would crash in the scan carry (or silently change
-                # numerics) instead of refusing cleanly here
-                "momentum_dtype": momentum_dtype_str(),
-                # resident mode is wave_size=0; a wave-scheduled snapshot
-                # (different payload: host pools + perm) must be refused
-                # here, not crash in PopState reconstruction
-                "wave_size": 0,
-            },
-        )
+        ck_config = {
+            "workload": getattr(workload, "name", type(workload).__name__),
+            "population": population,
+            "generations": generations,
+            "steps_per_gen": steps_per_gen,
+            "seed": seed,
+            "launch_lens": launch_lens,
+            "member_chunk": member_chunk,
+            # PBT knobs change exploit/explore behavior: resuming under
+            # a different cfg would not be the continuation we promise
+            "cfg": dataclasses.asdict(cfg),
+            # step_chunk changes the RNG derivation (folded sub-segment
+            # keys), i.e. the trajectory itself — not just the launch
+            # split the way gen_chunk does
+            "step_chunk": step_chunk,
+            # the momentum STORAGE dtype is part of the carried state's
+            # structure: resuming a bf16-momentum snapshot into an f32
+            # trainer would crash in the scan carry (or silently change
+            # numerics) instead of refusing cleanly here
+            "momentum_dtype": momentum_dtype_str(),
+            # resident mode is wave_size=0; a wave-scheduled snapshot
+            # (different payload: host pools + perm) must be refused
+            # here, not crash in PopState reconstruction
+            "wave_size": 0,
+        }
+        if objectives is not None:
+            # objective identity is part of the trajectory (selection
+            # pressure differs per spec); scalar sweeps never write the
+            # key, so every pre-existing snapshot still resumes
+            ck_config["objectives"] = objectives.spec()
+        snap = SweepCheckpointer(checkpoint_dir, ck_config)
         restored = snap.restore_population_sweep()
         if restored is not None:
             state, unit, k_run, scores, meta = restored
@@ -1169,6 +1260,11 @@ def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, expl
             with oom_funnel(), trace.span(
                 "train", launch=i + 1, gens=launch_lens[i]
             ) as _sp:
+                if objectives is not None:
+                    # mark MO launches in the trace (registered span
+                    # attr); selection still runs inside this same
+                    # program — no extra host-sync span appears
+                    _sp["objectives"] = ",".join(objectives.names)
                 # chaos seam (inject_oom): one guarded launch ordinal; a
                 # synthetic RESOURCE_EXHAUSTED here classifies exactly
                 # like a real warmup OOM (the staging.py docstring's
@@ -1191,6 +1287,26 @@ def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, expl
                         steps_per_gen,
                         step_chunk,
                         cfg,
+                    )
+                elif objectives is not None:
+                    # the MO program journals the raw objective matrix per
+                    # generation besides the scalarized curve; selection
+                    # already happened on-device via pareto_score
+                    state, unit, k_run, best, mean, fails, final_scores, pre_s, pre_u, pre_mo, final_mo = run_fused_pbt(
+                        trainer,
+                        state,
+                        unit,
+                        hparams_fn,
+                        train_x=train_x,
+                        train_y=train_y,
+                        val_x=val_x,
+                        val_y=val_y,
+                        key=k_run,
+                        discrete_mask=disc,
+                        generations=launch_lens[i],
+                        steps_per_gen=steps_per_gen,
+                        cfg=cfg,
+                        objectives=objectives,
                     )
                 else:
                     # k_run is the scan-carried key returned by the previous
@@ -1218,6 +1334,8 @@ def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, expl
                 mean_parts.append(fetch_global(mean))
                 fail_parts.append(fetch_global(fails))
                 scores = fetch_global(final_scores)
+                if objectives is not None:
+                    np_final_mo = fetch_global(final_mo)
                 # flops only after the fetch barrier completed: a launch
                 # that raised mid-span emits its partial duration
                 # WITHOUT the attr (no inflated TF/s from partial work)
@@ -1237,6 +1355,9 @@ def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, expl
                 # generations of a resume verify instead of re-writing
                 np_pre_s = fetch_global(pre_s)
                 np_pre_u = fetch_global(pre_u)
+                np_pre_mo = (
+                    fetch_global(pre_mo) if objectives is not None else None
+                )
                 gens_before = sum(launch_lens[:i])
                 for j in range(launch_lens[i]):
                     g = gens_before + j
@@ -1247,6 +1368,7 @@ def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, expl
                         np_pre_u[j],
                         np_pre_s[j],
                         step=(g + 1) * steps_per_gen,
+                        scores_mo=None if np_pre_mo is None else np_pre_mo[j],
                     )
             is_last = i + 1 == n_launches
             due = (i + 1) % snapshot_every == 0
@@ -1299,6 +1421,35 @@ def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, expl
     # best_params=None with diverged=True
     best_i, diverged = finite_winner(scores)
     np_unit = fetch_global(unit)
+    pareto = None
+    if objectives is not None and np_final_mo is not None:
+        from mpi_opt_tpu.objectives import (
+            hypervolume,
+            pareto_front_mask,
+            select_best,
+        )
+
+        # constraint-aware winner override: "best" under objectives is
+        # the best FEASIBLE member (typed degradation to the
+        # least-violating one when none is feasible — never a crash)
+        sel = select_best(np_final_mo, objectives)
+        if sel["index"] is None:
+            best_i, diverged = 0, True
+        else:
+            best_i, diverged = int(sel["index"]), False
+        norm = objectives.normalize(np_final_mo)
+        mask = pareto_front_mask(norm)
+        front_members = [int(i) for i in np.flatnonzero(mask)]
+        pareto = {
+            "front_size": len(front_members),
+            "front_members": front_members,
+            "front_scores": [
+                [float(v) for v in np_final_mo[i]] for i in front_members
+            ],
+            "hypervolume": float(hypervolume(norm[mask])) if front_members else 0.0,
+            "selection": sel["kind"],
+            "violation": sel["violation"],
+        }
     return {
         # diverged normalizes to NaN (not a raw +/-inf row) so library
         # callers can detect it uniformly across fused SHA/PBT/TPE
@@ -1328,4 +1479,12 @@ def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, expl
         "journal": None
         if journal is None
         else {"written": journal.written, "verified": journal.verified},
+        # multi-objective extras (ISSUE 17): the final population's
+        # non-dominated front + hypervolume and how the winner was
+        # selected (feasible / least_violation / diverged). None on
+        # scalar sweeps, and on a resume that restarted past the final
+        # launch (the final objective matrix lives in the ledger then —
+        # ``report`` recomputes the front from journaled vectors)
+        "objectives": None if objectives is None else list(objectives.names),
+        "pareto": pareto,
     }

@@ -398,6 +398,47 @@ class PopulationTrainer:
         correct, _ = jax.lax.scan(chunk_step, jnp.zeros((state.step.shape[0],), jnp.int32), (vx, vy))
         return correct.astype(jnp.float32) / n_val
 
+    # -- multi-objective member metrics (ISSUE 17) ------------------------
+
+    @functools.partial(jax.jit, static_argnames=("self", "threshold"))
+    def member_effective_params(
+        self, state: PopState, threshold: float = 1e-3
+    ) -> jax.Array:
+        """Effective parameter count per member: float32[P].
+
+        Counts weights with ``|w| > threshold`` — the model-size
+        objective of the multi-objective eval path. Unlike the dense
+        parameter count (identical across members — static shapes),
+        this varies with each member's weight-decay trajectory, so
+        "accuracy vs params" is a real trade-off the search can move
+        along. Members with any non-finite weight poison to NaN, which
+        is what marks a diverged member infeasible in every objective
+        consumer (journal status, Pareto ok-mask, warm-start guard).
+        """
+        n = state.step.shape[0]
+        count = jnp.zeros((n,), jnp.float32)
+        bad = jnp.zeros((n,), bool)
+        for leaf in jax.tree.leaves(state.params):
+            axes = tuple(range(1, leaf.ndim))
+            count = count + jnp.sum(
+                (jnp.abs(leaf) > threshold).astype(jnp.float32), axis=axes
+            )
+            bad = bad | ~jnp.all(jnp.isfinite(leaf), axis=axes)
+        return jnp.where(bad, jnp.nan, count)
+
+    @functools.partial(jax.jit, static_argnames=("self",))
+    def member_latency_proxy(self, state: PopState) -> jax.Array:
+        """Step-time latency proxy per member: float32[P], pseudo-ms.
+
+        ``2 * MACs / 1e6`` over the weights a structured-sparse kernel
+        could not skip (coarser prunability threshold than the params
+        metric, 1e-2) — a deterministic, device-computable stand-in
+        for inference step time that needs no wall-clock measurement
+        (which would not be per-member attributable inside one fused
+        program anyway).
+        """
+        return 2e-6 * self.member_effective_params(state, threshold=1e-2)
+
     # -- population surgery (exploit / slot management) ------------------
 
     @staticmethod

@@ -42,6 +42,20 @@ class Workload(abc.ABC):
         _, score = self.train(state, params, budget, seed)
         return float(score)
 
+    # -- multi-objective protocol (ISSUE 17) ------------------------------
+
+    def objective_metrics(self) -> tuple[str, ...]:
+        """Metric names the workload's multi-metric eval path can
+        produce (empty = scalar-only). An ``--objectives`` spec must
+        draw every name from this set; the CLI validates before
+        anything compiles."""
+        return ()
+
+    def evaluate_multi(self, params: dict, budget: int, seed: int, names) -> dict:
+        """Stateless multi-metric evaluation: ``{name: float}`` for the
+        requested metric names (each from ``objective_metrics``)."""
+        raise NotImplementedError(f"{self.name} has no multi-metric eval path")
+
 
 def resolve_momentum_dtype():
     """The single resolution point for the momentum STORAGE dtype knob
@@ -133,13 +147,8 @@ class PopulationWorkload(Workload):
             shift=values.get("shift", zeros),
         )
 
-    def evaluate(self, params: dict, budget: int, seed: int) -> float:
-        """Single-trial from-scratch training; see class docstring.
-
-        The trainer and device arrays are cached on the instance —
-        train_segment is jitted with ``self`` static, so a fresh trainer
-        per call would recompile every trial.
-        """
+    def _eval_state(self, params: dict, budget: int, seed: int):
+        """Shared n=1 from-scratch training for the stateless eval paths."""
         import jax
         import jax.numpy as jnp
 
@@ -161,5 +170,31 @@ class PopulationWorkload(Workload):
         k_init, k_train = jax.random.split(key)
         state = trainer.init_population(k_init, train_x[:2], 1)
         state, _ = trainer.train_segment(state, hp, train_x, train_y, k_train, int(budget))
+        return trainer, state, val_x, val_y
+
+    def evaluate(self, params: dict, budget: int, seed: int) -> float:
+        """Single-trial from-scratch training; see class docstring.
+
+        The trainer and device arrays are cached on the instance —
+        train_segment is jitted with ``self`` static, so a fresh trainer
+        per call would recompile every trial.
+        """
+        trainer, state, val_x, val_y = self._eval_state(params, budget, seed)
         acc = trainer.eval_population(state, val_x, val_y)
         return float(acc[0])
+
+    def objective_metrics(self) -> tuple[str, ...]:
+        from mpi_opt_tpu.train.common import POPULATION_METRICS
+
+        return POPULATION_METRICS
+
+    def evaluate_multi(self, params: dict, budget: int, seed: int, names) -> dict:
+        """Multi-metric twin of ``evaluate``: one n=1 training run, then
+        the same per-member metric columns the fused path computes
+        (``train.common.eval_population_objectives``), so driver-path
+        and fused-path objective values agree by construction."""
+        from mpi_opt_tpu.train.common import eval_population_objectives
+
+        trainer, state, val_x, val_y = self._eval_state(params, budget, seed)
+        mo = eval_population_objectives(trainer, state, val_x, val_y, tuple(names))
+        return {name: float(mo[0, j]) for j, name in enumerate(names)}

@@ -23,6 +23,7 @@
 #        T1_SKIP_ENOSPC_DRILL=1 probes/tier1.sh # skip the disk-full drill
 #        T1_SKIP_CORPUS_DRILL=1 probes/tier1.sh # skip the corpus/auto-warm-start drill
 #        T1_SKIP_FRONTDOOR_DRILL=1 probes/tier1.sh # skip the HTTP front-door drill
+#        T1_SKIP_PARETO_DRILL=1 probes/tier1.sh # skip the multi-objective drill
 set -o pipefail
 cd "$(dirname "$0")/.."
 T1_LOG="${T1_LOG:-/tmp/_t1.log}"
@@ -730,6 +731,80 @@ PYEOF
         echo "FRONTDOOR_DRILL=pass"
     else
         echo "FRONTDOOR_DRILL=FAIL"
+        rc=$(( rc == 0 ? 1 : rc ))
+    fi
+fi
+
+# -- Pareto drill (multi-objective subsystem, objectives/; ISSUE 17) --
+# A 2-objective fused ASHA sweep (accuracy:max,params:min on digits_mlp,
+# rungs [2,4,8] -> 11 member records) is hard-killed MID-JOURNAL of its
+# second rung, then: fsck --ledger must FLAG the torn boundary (exit 1),
+# --repair truncates it, --resume completes the sweep, and the resumed
+# ledger's `report --json` Pareto block (front membership, vectors,
+# hypervolume) must be IDENTICAL to an unkilled reference run's —
+# crash-recovery of the vector journal, not just the scalar one. The
+# report must also answer a --best-under constraint with exit 0, and the
+# recovered tree/ledger must pass fsck + report --validate clean.
+if [ -z "$T1_SKIP_PARETO_DRILL" ]; then
+    po_rc=0
+    PO=$(mktemp -d /tmp/_t1_pareto.XXXXXX)
+    mo_sweep() {  # $1=ledger $2=ckpt-dir, then extra args
+        timeout -k 10 300 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+            --workload digits_mlp --algorithm asha --fused --no-mesh \
+            --trials 6 --min-budget 2 --max-budget 8 --eta 2 --seed 0 \
+            --objectives accuracy:max,params:min \
+            --checkpoint-dir "$2" --ledger "$1" "${@:3}" >/dev/null 2>&1
+    }
+    mo_front() {  # $1=ledger -> canonical multi_objective JSON on stdout
+        timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+            report "$1" --json 2>/dev/null \
+            | python -c 'import json, sys; print(json.dumps(
+                json.load(sys.stdin)["ledgers"][0]["multi_objective"],
+                sort_keys=True))'
+    }
+    mo_sweep "$PO/ref.jsonl" "$PO/rck" || po_rc=1
+    mo_front "$PO/ref.jsonl" >"$PO/ref_mo.json" || po_rc=1
+    # kill the sweep after 1 member record of rung 1 hit the disk
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python - "$PO" >/dev/null 2>&1 <<'PYEOF'
+import os, sys
+import mpi_opt_tpu.ledger.store as ls
+orig = ls.SweepLedger._write_line
+n = [0]
+def dying_write(self, rec):
+    orig(self, rec)
+    n[0] += 1
+    if n[0] == 8:  # header + rung 0's 6 records + 1 of rung 1: die
+        os._exit(137)
+ls.SweepLedger._write_line = dying_write
+from mpi_opt_tpu.cli import main
+d = sys.argv[1]
+main(["--workload", "digits_mlp", "--algorithm", "asha", "--fused",
+      "--no-mesh", "--trials", "6", "--min-budget", "2", "--max-budget", "8",
+      "--eta", "2", "--seed", "0",
+      "--objectives", "accuracy:max,params:min",
+      "--checkpoint-dir", f"{d}/kck", "--ledger", f"{d}/killed.jsonl"])
+PYEOF
+    [ $? -eq 137 ] || po_rc=1                 # the kill must have landed
+    pareto_fsck() {
+        timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+            fsck "$PO/kck" --ledger "$PO/killed.jsonl" "$@" >/dev/null 2>&1
+    }
+    pareto_fsck; [ $? -eq 1 ] || po_rc=1      # torn boundary must be FLAGGED
+    pareto_fsck --repair; [ $? -eq 1 ] || po_rc=1  # found + repaired contract
+    mo_sweep "$PO/killed.jsonl" "$PO/kck" --resume || po_rc=1
+    mo_front "$PO/killed.jsonl" >"$PO/killed_mo.json" || po_rc=1
+    cmp -s "$PO/ref_mo.json" "$PO/killed_mo.json" || po_rc=1  # front identical
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        report "$PO/killed.jsonl" --best-under "params<=5000" \
+        >/dev/null 2>&1 || po_rc=1
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+        report --validate "$PO/killed.jsonl" >/dev/null 2>&1 || po_rc=1
+    pareto_fsck || po_rc=1                    # post-recovery audit is clean
+    rm -rf "$PO"
+    if [ $po_rc -eq 0 ]; then
+        echo "PARETO_DRILL=pass"
+    else
+        echo "PARETO_DRILL=FAIL"
         rc=$(( rc == 0 ? 1 : rc ))
     fi
 fi

@@ -129,6 +129,35 @@ def test_v2_trace_intra_phase_sections_are_optional():
         ), key
 
 
+def test_optional_scores_field_absent_valid_mistyped_flagged():
+    """ISSUE 17: bench config 8's multi-objective summary rides an
+    OPTIONAL ``scores`` object ({objective: number}) beside the scalar
+    metric. Absent is valid forever (the whole scalar history); present
+    it must keep the declared shape."""
+    # absent: valid (every pre-17 record)
+    assert validate_bench_record(_v2()) == []
+    # explicit null and a well-typed object: valid
+    assert validate_bench_record(_v2(scores=None)) == []
+    assert validate_bench_record(
+        _v2(scores={"accuracy": 0.93, "hypervolume_at_budget": 12.5})
+    ) == []
+    # mis-typed shapes are each flagged
+    for bad in (
+        [0.93, 12.5],  # a bare vector loses the objective names
+        {},  # present-but-empty says nothing
+        {"accuracy": "high"},
+        {"accuracy": True},  # JSON true is drift, not a score
+        "0.93",
+    ):
+        assert any(
+            "scores" in p for p in validate_bench_record(_v2(scores=bad))
+        ), bad
+    # legacy records (no schema_version) never grew the field; the gate
+    # only applies to v2 shapes, so history cannot be flagged
+    legacy = {"metric": "m", "value": 1.0, "unit": "trials/sec"}
+    assert validate_bench_record(legacy) == []
+
+
 def test_committed_bench_history_stays_valid():
     """BENCH_r01-r05 predate the schema_version field: they must
     validate as the legacy shape forever (the trajectory's early rounds

@@ -1,0 +1,312 @@
+"""Multi-objective subsystem (ISSUE 17): spec parsing, Pareto kernels
+vs brute-force oracles, constraint-aware selection tiers, hypervolume,
+jit-compilability, and the warm-start vector-score finiteness guard.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from mpi_opt_tpu.algorithms.base import Observation
+from mpi_opt_tpu.ledger.warmstart import best_observation, observation_fully_finite
+from mpi_opt_tpu.objectives import (
+    Objective,
+    ObjectiveSpec,
+    crowding_distance,
+    hypervolume,
+    parse_constraint,
+    pareto_front_mask,
+    pareto_rank,
+    pareto_score,
+    select_best,
+)
+
+# -- spec / syntax --------------------------------------------------------
+
+
+def test_parse_full_syntax():
+    spec = ObjectiveSpec.parse("accuracy:max,params:min<=2e4,latency:min")
+    assert spec.names == ("accuracy", "params", "latency")
+    assert spec.m == 3
+    assert [o.direction for o in spec.objectives] == ["max", "min", "min"]
+    assert spec.objectives[0].bound is None
+    assert spec.objectives[1].bound == 2e4
+    assert spec.has_bounds
+
+
+def test_parse_default_direction_is_max():
+    spec = ObjectiveSpec.parse("accuracy")
+    assert spec.objectives[0].direction == "max"
+    assert not spec.has_bounds
+
+
+def test_parse_operator_must_agree_with_direction():
+    # a bound means "at least this good": >= for max, <= for min
+    with pytest.raises(ValueError, match="contradicts direction"):
+        ObjectiveSpec.parse("params:min>=5")
+    with pytest.raises(ValueError, match="contradicts direction"):
+        ObjectiveSpec.parse("accuracy:max<=0.5")
+    # the agreeing forms parse
+    assert ObjectiveSpec.parse("accuracy:max>=0.5").objectives[0].bound == 0.5
+    assert ObjectiveSpec.parse("params:min<=5").objectives[0].bound == 5.0
+
+
+def test_parse_rejects_malformed():
+    with pytest.raises(ValueError):
+        ObjectiveSpec.parse("accuracy,,params")  # empty item
+    with pytest.raises(ValueError):
+        ObjectiveSpec.parse("accuracy:sideways")  # bad direction
+    with pytest.raises(ValueError):
+        ObjectiveSpec.parse("params:min<=not_a_number")
+    with pytest.raises(ValueError, match="duplicate"):
+        ObjectiveSpec.parse("accuracy,accuracy")
+    with pytest.raises(ValueError):
+        Objective(name="x", bound=float("nan"))
+
+
+def test_spec_round_trips_through_durable_form():
+    spec = ObjectiveSpec.parse("accuracy:max>=0.9,params:min<=2e4,latency:min")
+    again = ObjectiveSpec.from_spec(spec.spec())
+    assert again == spec
+    # frozen + tuple-backed: usable as a static jit argument
+    assert hash(again) == hash(spec)
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        spec.objectives[0].name = "x"
+
+
+def test_normalize_bounds_and_scalarize():
+    spec = ObjectiveSpec.parse("accuracy:max,params:min<=100")
+    assert list(spec.signs()) == [1.0, -1.0]
+    raw = np.array([[0.5, 40.0], [0.8, 250.0]])
+    norm = spec.normalize(raw)
+    np.testing.assert_allclose(norm, [[0.5, -40.0], [0.8, -250.0]])
+    nb = spec.norm_bounds()
+    assert nb[0] == -np.inf  # unconstrained
+    assert nb[1] == -100.0  # min<=100 in maximize form
+    np.testing.assert_allclose(spec.scalarize(raw), [0.5, 0.8])
+    # minimized primary scalarizes negated (higher is better)
+    spec2 = ObjectiveSpec.parse("loss:min,params:min")
+    np.testing.assert_allclose(spec2.scalarize(raw), [-0.5, -0.8])
+
+
+def test_parse_constraint_clause():
+    assert parse_constraint("params<=2e4") == ("params", "<=", 20000.0)
+    assert parse_constraint(" accuracy >= 0.9 ") == ("accuracy", ">=", 0.9)
+    with pytest.raises(ValueError):
+        parse_constraint("params=5")
+    with pytest.raises(ValueError):
+        parse_constraint("params<=banana")
+
+
+# -- device kernels vs brute-force oracles --------------------------------
+
+
+def _brute_front_ranks(s: np.ndarray) -> np.ndarray:
+    """Oracle: literal NSGA-II front peeling (front k = non-dominated
+    after removing fronts < k). Non-finite rows get rank n."""
+    n = s.shape[0]
+    ok = np.all(np.isfinite(s), axis=-1)
+    rank = np.full(n, n, dtype=np.int32)
+    remaining = set(np.where(ok)[0])
+    r = 0
+    while remaining:
+        front = [
+            i
+            for i in remaining
+            if not any(
+                np.all(s[j] >= s[i]) and np.any(s[j] > s[i])
+                for j in remaining
+                if j != i
+            )
+        ]
+        for i in front:
+            rank[i] = r
+        remaining -= set(front)
+        r += 1
+    return rank
+
+
+@pytest.mark.parametrize("n,m", [(1, 2), (7, 2), (16, 3), (9, 4)])
+def test_pareto_rank_matches_peeling_oracle(n, m):
+    rng = np.random.default_rng(n * 100 + m)
+    s = rng.normal(size=(n, m))
+    got = np.asarray(pareto_rank(s))
+    np.testing.assert_array_equal(got, _brute_front_ranks(s))
+
+
+def test_pareto_rank_nonfinite_and_masked_rows_rank_last():
+    s = np.array([[1.0, 1.0], [np.nan, 2.0], [0.5, 0.5], [2.0, np.inf]])
+    got = np.asarray(pareto_rank(s))
+    assert got[1] == 4 and got[3] == 4  # n, strictly after every front
+    assert got[0] == 0 and got[2] == 1
+    # valid mask composes with finiteness
+    masked = np.asarray(pareto_rank(s, valid=np.array([False, True, True, True])))
+    assert masked[0] == 4 and masked[2] == 0
+
+
+def test_pareto_rank_duplicates_share_a_front():
+    s = np.array([[1.0, 1.0], [1.0, 1.0], [0.0, 0.0]])
+    got = np.asarray(pareto_rank(s))
+    assert got[0] == got[1] == 0 and got[2] == 1
+
+
+@pytest.mark.parametrize("n,m", [(8, 2), (12, 3)])
+def test_front_mask_matches_rank_zero(n, m):
+    rng = np.random.default_rng(n + m)
+    s = rng.normal(size=(n, m))
+    mask = pareto_front_mask(s)
+    np.testing.assert_array_equal(mask, np.asarray(pareto_rank(s)) == 0)
+
+
+def test_crowding_boundaries_are_infinite_middle_is_finite():
+    # one front, sorted along a line: the two extremes are boundary
+    s = np.array([[0.0, 3.0], [1.0, 2.0], [2.0, 1.0], [3.0, 0.0]])
+    rank = pareto_rank(s)
+    d = np.asarray(crowding_distance(s, rank))
+    assert np.isinf(d[0]) and np.isinf(d[3])
+    assert np.isfinite(d[1]) and np.isfinite(d[2])
+    # the lonelier middle point is crowd-preferred
+    s2 = np.array([[0.0, 3.0], [0.1, 2.9], [2.0, 1.0], [3.0, 0.0]])
+    d2 = np.asarray(crowding_distance(s2, pareto_rank(s2)))
+    assert d2[2] > d2[1]
+
+
+def test_pareto_score_tier_ordering():
+    spec = ObjectiveSpec.parse("accuracy:max,params:min<=100")
+    raw = np.array(
+        [
+            [0.90, 50.0],  # feasible, front 0
+            [0.50, 40.0],  # feasible, dominated (worse acc, similar params)
+            [0.99, 250.0],  # infeasible (params over bound)
+            [0.95, 150.0],  # infeasible, smaller violation
+            [np.nan, 10.0],  # diverged
+        ]
+    )
+    eff = np.asarray(
+        pareto_score(spec.normalize(raw), norm_bounds=spec.norm_bounds())
+    )
+    order = list(np.argsort(-eff))
+    # feasible first (front order), then infeasible by least violation,
+    # then -inf for the diverged row
+    assert order[:2] == [0, 1]
+    assert order[2] == 3 and order[3] == 2
+    assert eff[4] == -np.inf
+    # every feasible strictly above every infeasible
+    assert eff[[0, 1]].min() > eff[[2, 3]].max()
+
+
+def test_pareto_score_unbounded_spec_has_no_infeasible_tier():
+    s = np.array([[1.0, 0.0], [0.0, 1.0], [-5.0, -5.0]])
+    eff = np.asarray(pareto_score(s))
+    assert np.isfinite(eff).all()
+    assert eff[2] < min(eff[0], eff[1])  # dominated ranks below the front
+
+
+def test_kernels_compile_under_jit():
+    s = np.random.default_rng(3).normal(size=(6, 2)).astype(np.float32)
+    nb = np.array([-np.inf, -1.0], np.float32)
+    r_jit = jax.jit(pareto_rank)(s)
+    np.testing.assert_array_equal(np.asarray(r_jit), _brute_front_ranks(s))
+    eff_jit = jax.jit(pareto_score)(s, norm_bounds=nb)
+    eff = pareto_score(s, norm_bounds=nb)
+    np.testing.assert_allclose(np.asarray(eff_jit), np.asarray(eff), rtol=1e-6)
+
+
+# -- hypervolume ----------------------------------------------------------
+
+
+def test_hypervolume_known_values():
+    # two rectangles 2x1 and 1x2 overlapping in the unit square: 3.0
+    assert hypervolume([[2.0, 1.0], [1.0, 2.0]], ref=[0.0, 0.0]) == pytest.approx(3.0)
+    # 1D degenerates to max - ref
+    assert hypervolume([[3.0], [5.0]], ref=[1.0]) == pytest.approx(4.0)
+    # self-referenced ref = per-objective front minimum: boundary points
+    # anchor zero, the interior point contributes its box
+    assert hypervolume([[3.0, 1.0], [2.0, 2.0], [1.0, 3.0]]) == pytest.approx(1.0)
+    # ... so a 2-point self-referenced front is 0 by convention
+    assert hypervolume([[2.0, 1.0], [1.0, 2.0]]) == 0.0
+
+
+def test_hypervolume_edge_cases():
+    assert hypervolume([]) == 0.0
+    assert hypervolume([[np.nan, 1.0]]) == 0.0  # non-finite rows drop
+    # dominated/below-ref points never add volume
+    assert hypervolume(
+        [[2.0, 2.0], [1.0, 1.0]], ref=[0.0, 0.0]
+    ) == pytest.approx(4.0)
+    with pytest.raises(ValueError):
+        hypervolume([1.0, 2.0])  # not [n, m]
+
+
+def test_hypervolume_deterministic_under_row_order():
+    rng = np.random.default_rng(11)
+    pts = rng.uniform(size=(6, 3))
+    perm = rng.permutation(6)
+    assert hypervolume(pts) == pytest.approx(hypervolume(pts[perm]))
+
+
+# -- constraint-aware winner pick (typed degradation) ---------------------
+
+
+def test_select_best_feasible():
+    spec = ObjectiveSpec.parse("accuracy:max,params:min<=100")
+    raw = [[0.90, 50.0], [0.95, 200.0], [0.80, 80.0]]
+    got = select_best(raw, spec)
+    assert got == {"index": 0, "kind": "feasible", "violation": 0.0}
+    assert isinstance(got["index"], int)  # host values, not np scalars
+
+
+def test_select_best_degrades_to_least_violation():
+    spec = ObjectiveSpec.parse("accuracy:max,params:min<=100")
+    raw = [[0.90, 300.0], [0.95, 150.0]]
+    got = select_best(raw, spec)
+    assert got["kind"] == "least_violation"
+    assert got["index"] == 1
+    assert got["violation"] == pytest.approx(0.5)  # (150-100)/100
+
+
+def test_select_best_diverged_and_nan_disqualifies_row():
+    spec = ObjectiveSpec.parse("accuracy:max,params:min<=100")
+    assert select_best([[np.nan, 5.0], [np.inf, 1.0]], spec) == {
+        "index": None,
+        "kind": "diverged",
+        "violation": None,
+    }
+    # a NaN in ANY objective knocks the row out even if primary looks fine
+    got = select_best([[0.99, np.nan], [0.5, 50.0]], spec)
+    assert got["index"] == 1 and got["kind"] == "feasible"
+
+
+def test_select_best_unconstrained_spec_picks_primary():
+    spec = ObjectiveSpec.parse("accuracy:max,params:min")
+    got = select_best([[0.7, 10.0], [0.9, 99.0]], spec)
+    assert got["index"] == 1 and got["kind"] == "feasible"
+
+
+# -- warm-start vector-score guard (satellite 2) --------------------------
+
+
+def _obs(score, scores=None):
+    return Observation(unit=np.zeros(2, np.float32), score=score, scores=scores)
+
+
+def test_observation_fully_finite_scalar_and_vector():
+    assert observation_fully_finite(_obs(0.5))
+    assert not observation_fully_finite(_obs(float("nan")))
+    assert observation_fully_finite(_obs(0.5, scores=(0.5, 100.0)))
+    # NaN in ANY objective disqualifies, even with a healthy scalar
+    assert not observation_fully_finite(_obs(0.5, scores=(0.5, float("nan"))))
+    assert not observation_fully_finite(_obs(0.5, scores=(float("inf"), 1.0)))
+    # a None entry (journaled null) is non-finite by definition
+    assert not observation_fully_finite(_obs(0.5, scores=(0.5, None)))
+
+
+def test_best_observation_skips_partially_diverged_vectors():
+    healthy = _obs(0.6, scores=(0.6, 120.0))
+    tainted = _obs(0.9, scores=(0.9, float("nan")))  # best scalar, bad vector
+    diverged = _obs(float("nan"))
+    assert best_observation([tainted, healthy, diverged]) is healthy
+    assert best_observation([tainted, diverged]) is None
+    assert best_observation([]) is None
