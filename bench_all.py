@@ -806,6 +806,56 @@ def bench_config8(seed: int, population: int = 8, generations: int = 3,
     }
 
 
+def bench_config9(seed: int, trials: int = 64, min_budget: int = 10,
+                  max_budget: int = 270, eta: int = 3, wave_size: int = 16):
+    """Wave-scheduled fused SHA (ISSUE 18): the config-2 sweep with its
+    rung cohorts capped at ``wave_size`` resident members, streamed
+    through the shared engine's host pool (train/engine.py). Headline
+    is trials/s with the stage-in/stage-out traffic in the loop —
+    comparable to config 2's resident number, so the trajectory can see
+    the price of waves directly. The record also carries the engine's
+    staging counters (overlap efficiency is ALSO gated via the embedded
+    trace's ``staging`` section when traced)."""
+    from mpi_opt_tpu.train.fused_asha import fused_sha
+    from mpi_opt_tpu.workloads import get_workload
+
+    device = _tpu_setup()
+    wl = get_workload("fashion_mlp")
+    kw = dict(n_trials=trials, min_budget=min_budget, max_budget=max_budget,
+              eta=eta, seed=seed, wave_size=wave_size)
+    t0 = time.perf_counter()
+    res = fused_sha(wl, **kw)  # warmup: compile wave + boundary programs
+    log(f"[config9] warmup {time.perf_counter()-t0:.1f}s")
+    t0 = time.perf_counter()
+    fused_sha(wl, **kw)
+    warm_wall = time.perf_counter() - t0
+    wall, walls, k = timed_region(lambda: fused_sha(wl, **kw), warm_wall)
+    log(
+        f"[config9] waves={res.get('waves_run')} "
+        f"staged={res.get('staged_bytes', 0) >> 20}MiB "
+        f"overlap={res.get('stage_overlap_s', 0.0):.2f}s"
+    )
+    return {
+        "config": 9,
+        "metric": "wave_sha64_fashion_mlp_trials_per_sec_per_chip",
+        "value": round(k * res["n_trials"] / wall, 4),
+        "unit": "trials/sec/chip",
+        "hardware": device,
+        "rung_budgets": res["rung_budgets"],
+        "rung_sizes": res["rung_sizes"],
+        "best_score": round(res["best_score"], 4),
+        "wave_size": res.get("wave_size", wave_size),
+        "waves_run": res.get("waves_run"),
+        "staged_bytes": res.get("staged_bytes"),
+        "stage_transfer_s": round(res.get("stage_transfer_s", 0.0), 3),
+        "stage_wait_s": round(res.get("stage_wait_s", 0.0), 3),
+        "stage_overlap_s": round(res.get("stage_overlap_s", 0.0), 3),
+        "wall_s": round(wall, 2),
+        "wall_s_runs": [round(w, 2) for w in walls],
+        "sweeps_per_region": k,
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--configs", default="1,2,3,4,5")
@@ -888,6 +938,7 @@ def main():
         "6": lambda: bench_config6(args.seed),
         "7": lambda: bench_config7(args.seed),
         "8": lambda: bench_config8(args.seed),
+        "9": lambda: bench_config9(args.seed),
     }
     # validate BEFORE measuring: a bad token must not cost a bench run
     wanted = [c.strip() for c in args.configs.split(",") if c.strip()]

@@ -288,26 +288,27 @@ def build_parser() -> argparse.ArgumentParser:
         "--wave-size",
         default="0",
         metavar="N|auto",
-        help="fused pbt: population > device residency — train resident "
-        "waves of N members per generation, staging cold members' "
-        "params+momentum on host between waves (double-buffered async "
-        "transfers overlap wave compute); exploit/explore still runs "
-        "over the FULL population. 'auto' sizes the wave from a "
-        "residency estimate; 0 disables (fully resident). Bit-identical "
-        "to resident mode on the CPU backend (tested); see README "
-        "'Wave scheduling'",
+        help="fused sweeps (any algorithm): cohort > device residency — "
+        "train resident waves of N members per generation/rung/batch, "
+        "staging cold members on host between waves (double-buffered "
+        "async transfers overlap wave compute); the boundary op "
+        "(exploit, rung cut, re-suggest) still runs over the FULL "
+        "cohort. 'auto' sizes the wave from a residency estimate; 0 "
+        "disables (fully resident). Bit-identical to resident mode on "
+        "the CPU backend (tested); see README 'Wave scheduling'",
     )
     p.add_argument(
         "--oom-backoff",
         type=int,
         default=2,
         metavar="N",
-        help="fused pbt wave mode: on a device OOM (XLA "
+        help="fused wave mode (any algorithm): on a device OOM (XLA "
         "RESOURCE_EXHAUSTED), automatically halve the wave size and "
-        "re-run the generation — bit-identical at any wave size — up "
-        "to N times (0 disables). Also pre-clamps an explicit "
-        "--wave-size against the measured device budget. Resident-mode "
-        "and post-budget OOMs exit 74 (classified, non-retryable)",
+        "re-run the generation/rung/batch — bit-identical at any wave "
+        "size — up to N times (0 disables). Also pre-clamps an "
+        "explicit --wave-size against the measured device budget. "
+        "Resident-mode and post-budget OOMs exit 74 (classified, "
+        "non-retryable)",
     )
     p.add_argument(
         "--objectives",
@@ -1057,6 +1058,23 @@ def _open_fused_ledger(args, parser, space, metrics):
     return ledger
 
 
+def _wave_extras(res: dict) -> dict:
+    """Wave-scheduling observability fields for the fused summary —
+    the staging traffic and how much of it the double buffer hid
+    behind compute. Empty when the sweep ran resident; shared across
+    all wave-capable algorithms so the summary shape cannot drift."""
+    if not res.get("wave_size"):
+        return {}
+    return dict(
+        wave_size=res["wave_size"],
+        n_waves=res["n_waves"],
+        staged_bytes=res["staged_bytes"],
+        stage_overlap_s=round(res["stage_overlap_s"], 3),
+        stage_wait_s=round(res["stage_wait_s"], 3),
+        oom_backoffs=res.get("oom_backoffs", 0),
+    )
+
+
 def _run_fused_dispatch(
     args,
     parser,
@@ -1102,17 +1120,7 @@ def _run_fused_dispatch(
             ), args.retries, metrics)
             n_trials = args.population * args.generations
             extra = {"best_curve": [round(float(v), 4) for v in res["best_curve"]]}
-            if res.get("wave_size"):
-                # wave-scheduling observability: the staging traffic and
-                # how much of it the double buffer hid behind compute
-                extra.update(
-                    wave_size=res["wave_size"],
-                    n_waves=res["n_waves"],
-                    staged_bytes=res["staged_bytes"],
-                    stage_overlap_s=round(res["stage_overlap_s"], 3),
-                    stage_wait_s=round(res["stage_wait_s"], 3),
-                    oom_backoffs=res.get("oom_backoffs", 0),
-                )
+            extra.update(_wave_extras(res))
         elif args.algorithm in ("asha", "random"):
             from mpi_opt_tpu.train.fused_asha import fused_sha
 
@@ -1132,6 +1140,8 @@ def _run_fused_dispatch(
                 seed=args.seed,
                 member_chunk=args.member_chunk,
                 mesh=mesh,
+                wave_size=args.wave_size,
+                oom_backoff=args.oom_backoff,
                 checkpoint_dir=args.checkpoint_dir,
                 ledger=ledger,
                 warm_obs=warm_obs,
@@ -1139,6 +1149,7 @@ def _run_fused_dispatch(
             ), args.retries, metrics)
             n_trials = res["n_trials"]
             extra = {"rung_sizes": res["rung_sizes"], "rung_budgets": res["rung_budgets"]}
+            extra.update(_wave_extras(res))
         elif args.algorithm == "tpe":
             from mpi_opt_tpu.train.fused_tpe import fused_tpe
 
@@ -1150,12 +1161,15 @@ def _run_fused_dispatch(
                 seed=args.seed,
                 member_chunk=args.member_chunk,
                 mesh=mesh,
+                wave_size=args.wave_size,
+                oom_backoff=args.oom_backoff,
                 checkpoint_dir=args.checkpoint_dir,
                 ledger=ledger,
                 warm_obs=warm_obs,
             ), args.retries, metrics)
             n_trials = res["n_trials"]
             extra = {"best_curve": [round(float(v), 4) for v in res["best_curve"]]}
+            extra.update(_wave_extras(res))
         elif args.algorithm == "hyperband":
             from mpi_opt_tpu.train.fused_asha import fused_hyperband
 
@@ -1166,12 +1180,15 @@ def _run_fused_dispatch(
                 seed=args.seed,
                 member_chunk=args.member_chunk,
                 mesh=mesh,
+                wave_size=args.wave_size,
+                oom_backoff=args.oom_backoff,
                 checkpoint_dir=args.checkpoint_dir,
                 ledger=ledger,
                 warm_obs=warm_obs,
             ), args.retries, metrics)
             n_trials = res["n_trials"]
             extra = {"brackets": res["brackets"]}
+            extra.update(_wave_extras(res))
         elif args.algorithm == "bohb":
             from mpi_opt_tpu.train.fused_bohb import fused_bohb
 
@@ -1182,12 +1199,15 @@ def _run_fused_dispatch(
                 seed=args.seed,
                 member_chunk=args.member_chunk,
                 mesh=mesh,
+                wave_size=args.wave_size,
+                oom_backoff=args.oom_backoff,
                 checkpoint_dir=args.checkpoint_dir,
                 ledger=ledger,
                 warm_obs=warm_obs,
             ), args.retries, metrics)
             n_trials = res["n_trials"]
             extra = {"brackets": res["brackets"]}
+            extra.update(_wave_extras(res))
         else:
             # registry-drift guard: unreachable while every registered
             # algorithm has a fused branch above (argparse's choices
@@ -1500,10 +1520,11 @@ def main(argv=None, *, _workload=None) -> int:
     if args.oom_backoff < 0:
         parser.error(f"--oom-backoff must be >= 0, got {args.oom_backoff}")
     if args.wave_size:
-        if not args.fused or args.algorithm != "pbt":
+        if not args.fused:
             parser.error(
-                "--wave-size schedules a fused PBT population through "
-                "host-staged waves; it requires --fused --algorithm pbt"
+                "--wave-size schedules a fused cohort through host-staged "
+                "waves (engine); it requires --fused (any algorithm: "
+                "pbt/asha/random/tpe/hyperband/bohb)"
             )
         if args.gen_chunk > 1 or args.step_chunk > 0:
             parser.error(

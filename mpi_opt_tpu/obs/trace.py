@@ -197,10 +197,15 @@ def span(name: str, **attrs):
     untouched — the span still emits, so a crashed phase is visible in
     the attribution rather than vanishing from it."""
     st = _stack()
-    frame = [name, 0.0]
+    # an ``op`` attr joins the phase name (``boundary:rung_cut``): the
+    # phase feeds heartbeat records and stall attribution, where "which
+    # boundary op" is the question — the emitted span keeps the bare
+    # name so per-kind aggregation is unchanged
+    phase = f"{name}:{attrs['op']}" if "op" in attrs else name
+    frame = [phase, 0.0]
     st.append(frame)
     global _LAST_PHASE
-    _LAST_PHASE = name
+    _LAST_PHASE = phase
     ann = None
     if profiling.active():  # TraceAnnotation only under a live profiler
         try:

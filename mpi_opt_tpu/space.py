@@ -188,7 +188,17 @@ class SearchSpace:
 
         Jittable; used on-device to turn a population matrix into the
         per-member hyperparameter arrays fed to the train step.
+
+        The input is coerced to a jax array FIRST: domain maps mix
+        float64 numpy scalars into their arithmetic (e.g. LogUniform's
+        ``np.log`` bounds), and on a plain numpy ``u`` (a
+        snapshot-restored cohort) NumPy would run the intermediate math
+        in float64 and round to float32 only at the final jnp op —
+        double rounding that flips the last ulp of values like the
+        learning rate versus the all-float32 on-device path. A resumed
+        sweep must map bit-identical hparams to the run it resumes.
         """
+        u = jnp.asarray(u)
         return {
             name: dom.from_unit(u[..., i])
             for i, (name, dom) in enumerate(self.domains.items())

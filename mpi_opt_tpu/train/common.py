@@ -95,8 +95,9 @@ def oom_funnel(wave_size=None):
     re-raises as ``utils.resources.DeviceOOM`` — the ONE type the CLI's
     classified exit (``EX_IOERR``) and the wave scheduler's
     ``--oom-backoff`` handler catch. All four fused drivers classify
-    through this door (run_fused wraps the whole dispatch; fused_pbt
-    additionally guards each wave so backoff can catch per-generation);
+    through this door (run_fused wraps the whole dispatch; the shared
+    wave engine — train/engine.py, all algorithms — additionally
+    guards each wave so backoff can catch per generation/rung/batch);
     everything else propagates raw. ``wave_size`` rides on the typed
     error so diagnostics can say what to halve."""
     from mpi_opt_tpu.utils.resources import oom_funnel as _funnel

@@ -30,6 +30,7 @@ import functools
 import hashlib
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from mpi_opt_tpu.obs import memory, trace
@@ -45,6 +46,19 @@ from mpi_opt_tpu.train.common import (
     segment_flops_hint,
     workload_arrays,
 )
+
+# the shared fault-tolerant wave executor (train/engine.py): wave
+# scheduling, host-pool staging, OOM backoff, drain/heartbeat — this
+# module supplies only SHA's boundary op (the rung cut). The private
+# ``_run_wave`` alias is this module's chaos-drill seam, mirroring
+# fused_pbt's.
+from mpi_opt_tpu.train.engine import (
+    WaveRunner,
+    boundary_span,
+    resolve_wave_size,
+)
+from mpi_opt_tpu.train.engine import run_wave as _run_wave
+from mpi_opt_tpu.train.population import PopState
 from mpi_opt_tpu.utils import profiling
 
 
@@ -71,6 +85,20 @@ def _cut_and_gather_mo(trainer, state, unit, norm_scores, eta: int, k: int, norm
     promote, order, _eff = asha_cut_mo(norm_scores, eta, norm_bounds=norm_bounds)
     keep = order[:k]
     return trainer.gather_members(state, keep), unit[keep], keep, promote
+
+
+@functools.partial(jax.jit, static_argnames=("eta", "k"))
+def _wave_cut(unit, scores, eta: int, k: int):
+    """The rung cut for wave-scheduled cohorts: rank + keep exactly as
+    ``_cut_and_gather`` does, minus the on-device state gather — the
+    survivor-weight copy is realized LAZILY by the next rung's stage-in
+    indexing the host pool with ``keep`` (train/staging.py; the
+    ``fused_pbt._wave_exploit`` precedent: a separate-jit boundary op
+    preserves CPU bit-identity with the fused one). Returns
+    (survivor_unit, keep_idx)."""
+    _promote, order = asha_cut(scores, eta)
+    keep = order[:k]
+    return unit[keep], keep
 
 
 def sha_cohort_sizes(n_trials: int, n_rungs: int, eta: int, round_to: int = 1) -> list[int]:
@@ -105,8 +133,24 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
     member_offset: int = 0,
     warm_obs=None,
     objectives=None,
+    wave_size=0,
+    oom_backoff: int = 2,
 ):
     """Run a whole successive-halving sweep with on-device rung cuts.
+
+    ``wave_size`` (int or ``'auto'``; the carried PR-4 follow-up, via
+    the shared engine) schedules each RUNG's cohort as resident waves
+    through a host pool when it exceeds device residency — per-rung
+    re-cohorting: every rung gets a fresh pool sized to its (shrinking)
+    cohort, and the cut's survivor gather is realized by the next
+    rung's stage-in permutation. Bit-identical to resident mode for any
+    wave size on the CPU backend (tested): hparams are mapped eagerly
+    over the FULL cohort exactly as the resident rung does (then sliced
+    per wave — slicing is exact), member/batch RNG windows the full
+    split, init keys slice the same ``split(k_init, n)``, and the cut
+    sees the same (scores, eta, k). ``oom_backoff`` extends the PBT
+    wave-halving contract to rungs: a device OOM during a rung's waves
+    halves the cap and re-runs THAT rung from wave 0, bit-identically.
 
     ``ledger`` journals one record per surviving trial per rung —
     pre-cut score at the rung's budget, the trial's unit params —
@@ -143,10 +187,30 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
     vector per record. The scalar path is untouched.
     """
     from mpi_opt_tpu.parallel.mesh import fetch_global, place_pop, shard_popstate
+    from mpi_opt_tpu.train.staging import population_pool, write_rows
 
     trainer, space, train_x, train_y, val_x, val_y = workload_arrays(
         workload, member_chunk, mesh
     )
+    # wave scheduling (cohort > residency): the shared engine door
+    # resolves ``auto``, pre-clamps explicit caps, refuses multi-process
+    # (train/engine.py). A cap at or above the first rung's cohort means
+    # everything fits — resident mode, the bit-identical baseline.
+    wave_size = resolve_wave_size(
+        trainer,
+        train_x[:2],
+        n_trials,
+        wave_size=wave_size,
+        mesh=mesh,
+        oom_backoff=oom_backoff,
+    )
+    waves = 0 < wave_size < n_trials
+    if waves and objectives is not None:
+        raise ValueError(
+            "wave scheduling is not supported with multi-objective "
+            "sweeps yet; run resident (wave_size=0) or shard the "
+            "cohort over a mesh"
+        )
     norm_bounds = None
     if objectives is not None:
         supported = tuple(workload.objective_metrics())
@@ -211,6 +275,18 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
                 else hashlib.sha1(init_unit.tobytes()).hexdigest()
             ),
         }
+        if waves:
+            # the wave split is part of a wave-scheduled sweep's
+            # identity: its snapshots resume through host pools. Resident
+            # configs deliberately DON'T write the key, so every
+            # pre-existing SHA snapshot keeps resuming via the
+            # ``setdefault(0)`` back-compat (utils/checkpoint.py) — and
+            # a wave resume of a resident snapshot refuses cleanly
+            # (0 != cap) instead of crashing in pool reconstruction.
+            # The REQUESTED (resolved) cap, as in fused_pbt: an OOM
+            # backoff's smaller execution cap lives in meta
+            # (wave_size_run) and is adopted on resume below
+            ck_config["wave_size"] = wave_size
         if objectives is not None:
             # objective identity shapes every cut (see fused_pbt); the
             # key is absent on scalar sweeps so pre-existing snapshots
@@ -228,6 +304,21 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
             # stop-rung observations are still in last_score, so the
             # history is marked partial rather than fabricated
             rung_history = list(meta.get("rung_history", []))
+            if waves:
+                # adopt a prior attempt's OOM-settled execution cap
+                # (meta wave_size_run): resuming at the requested size
+                # would re-OOM a rung just to re-learn the answer
+                run_wave_size = int(meta.get("wave_size_run", wave_size))
+                # the snapshot's survivor cohort becomes the next rung's
+                # host pool; its rows are already in cohort order, so
+                # the stage-in permutation starts as the identity
+                pool_front = {
+                    "params": jax.tree.map(np.asarray, state.params),
+                    "momentum": jax.tree.map(np.asarray, state.momentum),
+                    "step": np.asarray(state.step),
+                }
+                perm = np.arange(len(alive))
+                state = None
     journal = make_fused_journal(
         ledger,
         space,
@@ -253,10 +344,20 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
                     unit = np.array(unit)
                     unit[0] = np.asarray(bo.unit, dtype=unit.dtype)
                     unit = jax.numpy.asarray(unit)
-        state = trainer.init_population(k_init, train_x[:2], n_trials)
+        if waves:
+            # rung-0 members initialize on device per wave, windows of
+            # the SAME ``split(k_init, n)`` the resident
+            # ``init_population`` derives — weights are bit-identical
+            member_keys = jax.random.split(k_init, n_trials)
+            pool_front = None
+            perm = np.arange(n_trials)
+            state = None
+        else:
+            state = trainer.init_population(k_init, train_x[:2], n_trials)
     if mesh is not None:
         # datasets were already replicated over the mesh by workload_arrays
-        state = shard_popstate(state, mesh)
+        if not waves:
+            state = shard_popstate(state, mesh)
         unit = place_pop(unit, mesh)
 
     def record_rung(r: int, np_scores_r) -> None:
@@ -283,7 +384,20 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
     # host copies of the ledger at that rung. A fused JOURNAL forces the
     # eager path too: its records must be fsync-durable per rung (the
     # journal-before-snapshot ordering), which deferral would break.
-    defer = snap is None and journal is None
+    # Wave scheduling is eager by construction: every rung's scores land
+    # on host through the staging writers.
+    defer = snap is None and journal is None and not waves
+    runner = None
+    if waves:
+        # the shared wave executor (train/engine.py) owns the staging
+        # engine, the execution cap, and the OOM-backoff retry; the rung
+        # loop below supplies SHA's shapes and boundary op. Starts at
+        # the snapshot-adopted cap when resuming past a backoff.
+        runner = WaveRunner(
+            n_trials,
+            run_wave_size if restored is not None else wave_size,
+            oom_backoff=oom_backoff,
+        )
     rung_scores_dev: list = []  # device scores per rung (pre-cut rows)
     rung_keep_dev: list = []  # device survivor indices per cut
     rung_mo_dev: list = []  # device [n, m] objective matrices (MO only)
@@ -303,60 +417,183 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
             f = None if defer else segment_flops_hint(
                 workload, sizes[r], budget - prev_budget
             )
-            with trace.span(
-                "train",
-                launch=boundary_offset + r + 1,
-                rung=r + 1,
-                members=sizes[r],
-                steps=budget - prev_budget,
-            ) as sp:
-                if objectives is not None:
-                    # registered span attr: MO rungs are visible in the
-                    # trace; the cut still runs on-device (no new sync)
-                    sp["objectives"] = ",".join(objectives.names)
+            if waves:
+                n_r = sizes[r]
+                # EAGER unit->hparams mapping over the FULL cohort — the
+                # resident rung maps eagerly before train_segment, so
+                # the wave path must hand the programs the SAME values
+                # (sliced per wave inside run_wave; slicing is exact) to
+                # be bit-identical to it. This is NOT the PBT/TPE rule
+                # (their resident programs map in-scan): each wave path
+                # mirrors ITS resident twin.
                 hp = workload.make_hparams(space.from_unit(unit))
-                state, _ = trainer.train_segment(
-                    state, hp, train_x, train_y, k_seg, budget - prev_budget
-                )
-                if objectives is None:
-                    mo = None
-                    scores = trainer.eval_population(state, val_x, val_y)
-                else:
-                    # each metric call is its own jitted program, so the
-                    # dispatches stay async — the rung still pays at most
-                    # the one host fetch the eager path always paid
-                    mo = eval_population_objectives(
-                        trainer, state, val_x, val_y, objectives.names
+                # per-rung re-cohorting: a fresh pool sized to THIS
+                # rung's (shrinking) cohort; the previous rung's pool is
+                # read through the cut's survivor permutation
+                pool_back = population_pool(trainer, train_x[:2], n_r)
+                scores_host = np.full((n_r,), np.nan, np.float32)
+
+                def _writer(off, pool_back=pool_back, scores_host=scores_host):
+                    def on_host(host):  # sweeplint: barrier(stage-out landing: writes fetched wave states + scores into the rung pool)
+                        write_rows(pool_back, off, host["state"])
+                        w_ = len(host["scores"])
+                        scores_host[off : off + w_] = np.asarray(
+                            host["scores"], np.float32
+                        )
+
+                    return on_host
+
+                def _dispatch(
+                    w, off, wl_, eng, r=r, k_seg=k_seg, hp=hp, n_r=n_r,
+                    pool_front=pool_front, perm=perm,
+                    budget=budget, prev_budget=prev_budget,
+                ):
+                    # ``_run_wave`` resolved at call time (module
+                    # global) so the chaos drills' monkeypatch seam
+                    # keeps working
+                    return _run_wave(
+                        trainer,
+                        pool_front,
+                        perm[off : off + wl_],
+                        off,
+                        None,  # unit/hparams_fn unused: hp mode
+                        None,
+                        train_x,
+                        train_y,
+                        val_x,
+                        val_y,
+                        k_seg,
+                        budget - prev_budget,
+                        n_r,
+                        mesh,
+                        eng,
+                        init_keys=member_keys[off : off + wl_] if r == 0 else None,
+                        sample_x=train_x[:2],
+                        hp=hp,
                     )
-                    scores = objectives.scalarize(mo)
-                if defer:
-                    rung_scores_dev.append(scores)
-                    if mo is not None:
-                        rung_mo_dev.append(mo)
-                else:
-                    np_scores = fetch_global(scores)
-                    # ...and attached only AFTER the fetch barrier: a
-                    # rung that raised mid-span must not report
-                    # full-rung FLOPs over a partial duration
-                    if f:
-                        sp["flops"] = f
-                    # post-barrier device-memory watermark: the rung's
-                    # cohort + activations just peaked
-                    memory.note(sp)
-            if not defer:
-                np_mo = None if mo is None else fetch_global(mo)
-                np_final_mo = np_mo if np_mo is not None else np_final_mo
+
+                def _payload(st, sc):
+                    return {
+                        "state": {
+                            "params": st.params,
+                            "momentum": st.momentum,
+                            "step": st.step,
+                        },
+                        "scores": sc,
+                    }
+
+                wave_scores = runner.run_interval(
+                    n=n_r,
+                    run_wave_fn=_dispatch,
+                    payload_fn=_payload,
+                    writer_fn=_writer,
+                    scores_host=scores_host,
+                    stage_label=lambda w, nw, r=r: (
+                        f"sha rung {r + 1}/{len(rungs)} wave {w + 1}/{nw}"
+                    ),
+                    boundary_kwargs=lambda w, nw, r=r: {
+                        "rung": r + 1,
+                        "of": len(rungs),
+                    },
+                    # no mid-rung snapshots: SHA snapshots at rung
+                    # granularity (a resume re-trains the interrupted
+                    # rung; the journal verifies instead of re-writing)
+                    midpoint_snapshot=None,
+                    span_attrs=lambda nw, r=r, n_r=n_r: {
+                        "launch": boundary_offset + r + 1,
+                        "rung": r + 1,
+                        "members": n_r,
+                        "steps": budget - prev_budget,
+                        "waves": nw,
+                    },
+                    flops=f,
+                    notify_fields=(("rung", r + 1),),
+                )
+                mo = None
+                np_mo = None
+                # same device/host score pair the resident path holds:
+                # the concat feeds the cut, the landed host copy feeds
+                # the ledger (f32 round-trips exactly)
+                scores = jnp.concatenate([jnp.asarray(s) for s in wave_scores])
+                np_scores = scores_host.copy()
                 record_rung(r, np_scores)
                 if journal is not None:
-                    # one member record per PRE-cut survivor at this
-                    # rung's budget, before the rung snapshot below
                     journal_boundary(
                         journal, r, alive, fetch_global(unit), np_scores,
-                        step=budget, scores_mo=np_mo,
+                        step=budget,
                     )
-            if r < len(rungs) - 1:
-                with trace.span("boundary", op="rung_cut", rung=r + 1):
+                # fall through to the shared rung cut below
+            else:
+                with trace.span(
+                    "train",
+                    launch=boundary_offset + r + 1,
+                    rung=r + 1,
+                    members=sizes[r],
+                    steps=budget - prev_budget,
+                ) as sp:
+                    if objectives is not None:
+                        # registered span attr: MO rungs are visible in
+                        # the trace; the cut still runs on-device (no
+                        # new sync)
+                        sp["objectives"] = ",".join(objectives.names)
+                    hp = workload.make_hparams(space.from_unit(unit))
+                    state, _ = trainer.train_segment(
+                        state, hp, train_x, train_y, k_seg, budget - prev_budget
+                    )
                     if objectives is None:
+                        mo = None
+                        scores = trainer.eval_population(state, val_x, val_y)
+                    else:
+                        # each metric call is its own jitted program, so
+                        # the dispatches stay async — the rung still
+                        # pays at most the one host fetch the eager path
+                        # always paid
+                        mo = eval_population_objectives(
+                            trainer, state, val_x, val_y, objectives.names
+                        )
+                        scores = objectives.scalarize(mo)
+                    if defer:
+                        rung_scores_dev.append(scores)
+                        if mo is not None:
+                            rung_mo_dev.append(mo)
+                    else:
+                        np_scores = fetch_global(scores)
+                        # ...and attached only AFTER the fetch barrier:
+                        # a rung that raised mid-span must not report
+                        # full-rung FLOPs over a partial duration
+                        if f:
+                            sp["flops"] = f
+                        # post-barrier device-memory watermark: the
+                        # rung's cohort + activations just peaked
+                        memory.note(sp)
+                if not defer:
+                    np_mo = None if mo is None else fetch_global(mo)
+                    np_final_mo = np_mo if np_mo is not None else np_final_mo
+                    record_rung(r, np_scores)
+                    if journal is not None:
+                        # one member record per PRE-cut survivor at this
+                        # rung's budget, before the rung snapshot below
+                        journal_boundary(
+                            journal, r, alive, fetch_global(unit), np_scores,
+                            step=budget, scores_mo=np_mo,
+                        )
+            if r < len(rungs) - 1:
+                # boundary_span (train/engine.py): heartbeats from
+                # inside the op, so a stall DURING the cut is attributed
+                # to "boundary:rung_cut" by launch.py's stall report
+                with boundary_span("rung_cut", rung=r + 1):
+                    if waves:
+                        # survivor weights are NOT gathered on device:
+                        # the next rung's stage-in indexes the host pool
+                        # with ``keep`` (the wave path's lazy gather)
+                        unit, keep = _wave_cut(unit, scores, eta, sizes[r + 1])
+                        if mesh is not None:
+                            unit = place_pop(unit, mesh)
+                        np_keep = fetch_global(keep)
+                        alive = alive[np_keep]
+                        np_scores = np_scores[np_keep]
+                        perm = np.asarray(np_keep)
+                    elif objectives is None:
                         state, unit, keep, _ = _cut_and_gather(
                             trainer, state, unit, scores, eta, sizes[r + 1]
                         )
@@ -370,14 +607,14 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
                             sizes[r + 1],
                             norm_bounds=norm_bounds,
                         )
-                    if mesh is not None:
+                    if not waves and mesh is not None:
                         # re-place: the gather may leave survivors
                         # unsharded/skewed
                         state = shard_popstate(state, mesh)
                         unit = place_pop(unit, mesh)
                     if defer:
                         rung_keep_dev.append(keep)
-                    else:
+                    elif not waves:
                         np_keep = fetch_global(keep)
                         alive = alive[np_keep]
                         # post-cut survivors' scores, for a
@@ -386,20 +623,41 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
                         # an extra cross-process allgather per rung under
                         # multi-host)
                         np_scores = np_scores[np_keep]
+            if waves:
+                # the trained cohort now lives in this rung's pool: it
+                # becomes the next rung's stage-in source (read through
+                # ``perm``, the cut's survivor map)
+                pool_front = pool_back
             if snap is not None:
+                save_state = state
+                if waves:
+                    # materialize the CURRENT cohort (post-cut survivors;
+                    # the full final cohort at the last rung) from the
+                    # pool — fancy indexing copies, so the async orbax
+                    # write can never see later in-place pool writes
+                    sel = perm if r < len(rungs) - 1 else np.arange(sizes[r])
+                    save_state = PopState(
+                        params=jax.tree.map(lambda l: l[sel], pool_back["params"]),
+                        momentum=jax.tree.map(lambda l: l[sel], pool_back["momentum"]),
+                        step=pool_back["step"][sel],
+                    )
+                meta_extra = {
+                    "rungs_done": r + 1,
+                    # ledger cross-check unit (fsck, resume gate):
+                    # GLOBAL boundary count complete at this snapshot
+                    "boundaries_done": boundary_offset + r + 1,
+                    "alive": alive.tolist(),
+                    "stop_rung": stop_rung.tolist(),
+                    "last_score": [float(v) for v in last_score],
+                    "rung_history": rung_history,
+                }
+                if waves:
+                    # the OOM-settled execution cap (adopted on resume)
+                    meta_extra["wave_size_run"] = runner.wave_size
                 # scores saved = the CURRENT cohort rows (post-cut when cut)
                 snap.save_population_sweep(
-                    r + 1, state, unit, k_run, np_scores,
-                    meta_extra={
-                        "rungs_done": r + 1,
-                        # ledger cross-check unit (fsck, resume gate):
-                        # GLOBAL boundary count complete at this snapshot
-                        "boundaries_done": boundary_offset + r + 1,
-                        "alive": alive.tolist(),
-                        "stop_rung": stop_rung.tolist(),
-                        "last_score": [float(v) for v in last_score],
-                        "rung_history": rung_history,
-                    },
+                    r + 1, save_state, unit, k_run, np_scores,
+                    meta_extra=meta_extra,
                 )
             # heartbeat + graceful-shutdown drain: checkpointed sweeps
             # already snapshot every rung (nothing extra to flush);
@@ -412,6 +670,8 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
                 of=len(rungs),
             )
     finally:
+        if runner is not None:
+            runner.close()
         if snap is not None:
             snap.close()
 
@@ -502,6 +762,10 @@ def fused_sha(  # sweeplint: barrier(rung host loop: gathers cohort scores for t
         # rung (``report`` recomputes the front from the ledger then)
         "objectives": None if objectives is None else list(objectives.names),
         "pareto": pareto,
+        # wave-scheduling observability (the same keys every
+        # wave-scheduled driver reports — train/engine.py): settled
+        # execution split, OOM halvings, staged bytes, overlap
+        **({} if runner is None else runner.result_extras()),
     }
 
 
@@ -572,8 +836,16 @@ def fused_hyperband(
     observe_fn=None,
     ledger=None,
     warm_obs=None,
+    wave_size=0,
+    oom_backoff: int = 2,
 ):
     """Hyperband with every bracket running as a fused on-device SHA.
+
+    ``wave_size``/``oom_backoff`` pass straight through to each
+    bracket's ``fused_sha``: the cap is resolved against every
+    bracket's own cohort size (a small bracket that fits resident runs
+    resident), and each bracket's rungs get the engine's wave
+    scheduling + OOM wave-halving (train/engine.py).
 
     Brackets (algorithms.hyperband.bracket_plan) execute sequentially —
     each is one ``fused_sha`` sweep, so within a bracket the whole
@@ -604,6 +876,21 @@ def fused_hyperband(
     brackets = []
     n_total = 0
     journal_totals = {"written": 0, "verified": 0}
+    # wave observability aggregated across brackets (each bracket is its
+    # own fused_sha with its own resolved cap — a small bracket that
+    # fits resident contributes nothing): counters sum, the reported
+    # wave_size is the largest settled cap any bracket ran under
+    wave_totals = {
+        "wave_size": 0,
+        "n_waves": 0,
+        "waves_run": 0,
+        "oom_backoffs": 0,
+        "staged_bytes": 0,
+        "stage_transfer_s": 0.0,
+        "stage_wait_s": 0.0,
+        "stage_overlap_s": 0.0,
+    }
+    any_waves = False
     # the persisted-cohort identity: workload + bracket plan + seed
     # (everything that determines which search the cohorts belong to)
     tag = (
@@ -643,6 +930,8 @@ def fused_hyperband(
             # prior ingestion (ObsStore); only the hookless hyperband
             # seeds bracket cohorts with the prior best
             warm_obs=warm_obs if cohort_fn is None else None,
+            wave_size=wave_size,
+            oom_backoff=oom_backoff,
         )
         boundary_off += len(res["rung_budgets"])
         trial_off += sum(res["rung_sizes"])
@@ -666,6 +955,16 @@ def fused_hyperband(
         }
         if cohort_fn is not None:
             summary["n_model_sampled"] = n_model
+        if res.get("wave_size"):
+            any_waves = True
+            wave_totals["wave_size"] = max(wave_totals["wave_size"], res["wave_size"])
+            for k in ("n_waves", "waves_run", "oom_backoffs", "staged_bytes"):
+                wave_totals[k] += res[k]
+            for k in ("stage_transfer_s", "stage_wait_s", "stage_overlap_s"):
+                wave_totals[k] += res[k]
+            summary["wave_size"] = res["wave_size"]
+            summary["n_waves"] = res["n_waves"]
+            summary["oom_backoffs"] = res["oom_backoffs"]
         brackets.append(summary)
         # bracket boundary: each bracket's final rung suppresses the
         # intra-sha drain (final=True there), so the between-bracket
@@ -696,4 +995,5 @@ def fused_hyperband(
         ],
         "n_trials": n_total,
         "journal": journal_totals if ledger is not None else None,
+        **(wave_totals if any_waves else {}),
     }

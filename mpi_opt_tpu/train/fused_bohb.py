@@ -51,9 +51,19 @@ def fused_bohb(  # sweeplint: barrier(bracket host loop: files rung observations
     cfg: TPEConfig = TPEConfig(),
     ledger=None,
     warm_obs=None,
+    wave_size=0,
+    oom_backoff: int = 2,
 ):
     """Returns the overall best plus per-bracket summaries (including
     how many of each cohort came from the model vs uniform).
+
+    ``wave_size`` / ``oom_backoff`` pass straight through to each
+    bracket's ``fused_sha`` (via ``fused_hyperband``): brackets whose
+    cohorts exceed the cap run their rungs as host-staged waves through
+    the shared engine, with the same bit-identity and OOM-backoff
+    contract — the model hooks are untouched (the cohort is sampled on
+    host either way, and rung observations come from the same
+    ``rung_history`` ledger).
 
     ``ledger`` journals every bracket's rung evaluations at member
     granularity through ``fused_hyperband``'s per-bracket offsets.
@@ -93,9 +103,12 @@ def fused_bohb(  # sweeplint: barrier(bracket host loop: files rung observations
         # count bounded by the fixed bracket plan and cache-stable
         # across runs/resumes; the first n_model rows are used (the
         # batch is diversified, so any prefix is a valid draw set)
-        from mpi_opt_tpu.obs import trace
+        from mpi_opt_tpu.train.engine import boundary_span
 
-        with trace.span("boundary", op="suggest", bracket=b, n=n):
+        # boundary_span (not a bare trace span): the beat inside it
+        # attributes a stall during the acquisition to THIS op in
+        # launch.py's stall report
+        with boundary_span("suggest", bracket=b, n=n):
             sugg, _ = suggest(
                 k_model, s["unit"], s["score"], s["valid"], n_suggest=n, cfg=cfg
             )
@@ -123,6 +136,8 @@ def fused_bohb(  # sweeplint: barrier(bracket host loop: files rung observations
         cohort_fn=cohort_fn,
         observe_fn=observe_fn,
         ledger=ledger,
+        wave_size=wave_size,
+        oom_backoff=oom_backoff,
         # priors already live in the ObsStore above; passing them down
         # would ALSO seed bracket cohorts (the hookless-hyperband
         # semantic) and double-count the prior

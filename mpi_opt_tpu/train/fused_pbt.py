@@ -47,6 +47,23 @@ from mpi_opt_tpu.train.common import (
 from mpi_opt_tpu.utils import profiling, resources
 from mpi_opt_tpu.train.population import OptHParams, PopState, PopulationTrainer
 
+# the shared fault-tolerant wave executor (train/engine.py): wave
+# scheduling, host-pool staging, OOM backoff, drain/heartbeat — this
+# module supplies only PBT's boundary op (truncation exploit/explore).
+# The private aliases preserve this module's historical seams: tests
+# intercept ``fused_pbt._run_wave`` for crash/OOM drills.
+from mpi_opt_tpu.train.engine import (
+    WaveRunner,
+    boundary_span,
+    resolve_wave_size,
+    _wave_train_program,  # noqa: F401  (re-exported test seam)
+)
+from mpi_opt_tpu.train.engine import balanced_split as _balanced_split
+from mpi_opt_tpu.train.engine import engine_rollover as _engine_rollover  # noqa: F401
+from mpi_opt_tpu.train.engine import run_wave as _run_wave
+from mpi_opt_tpu.train.engine import wave_layout as _wave_layout
+from mpi_opt_tpu.train.engine import writable as _writable
+
 
 @functools.partial(
     jax.jit,
@@ -165,46 +182,6 @@ def run_fused_pbt(
     return state, unit, key, best, mean, fails, gen_scores[-1], pre_scores, pre_units
 
 
-def _balanced_split(total: int, chunk: int) -> list[int]:
-    """Split ``total`` into ceil(total/chunk) near-equal parts (lengths
-    differ by at most 1, so at most two distinct compiled program
-    lengths exist). Shared by gen_chunk (generations per launch) and
-    step_chunk (steps per sub-launch); total=0 yields [0] — one empty
-    part, matching the unchunked path's empty-scan behavior."""
-    if total <= 0:
-        return [0]
-    n_parts = -(-total // chunk)
-    base, rem = divmod(total, n_parts)
-    return [base + 1] * rem + [base] * (n_parts - rem)
-
-
-def _wave_layout(population: int, wave_size: int):
-    """(wave_lens, offs, n_waves) for a wave cap — recomputed in place
-    when the OOM backoff halves the cap mid-run."""
-    wave_lens = _balanced_split(population, wave_size)
-    offs = [0]
-    for w in wave_lens[:-1]:
-        offs.append(offs[-1] + w)
-    return wave_lens, offs, len(wave_lens)
-
-
-def _engine_rollover(old):
-    """Fresh StagingEngine carrying the old one's cumulative accounting
-    (results and trace attrs report RUN totals): after a device OOM the
-    old engine may hold a latched transfer error — ``device_get`` of a
-    never-materialized wave fails on the worker thread — which would
-    refuse every later ``stage_out`` on sight."""
-    from mpi_opt_tpu.train.staging import StagingEngine
-
-    old.close()
-    new = StagingEngine()
-    new.staged_bytes = old.staged_bytes
-    new.transfers = old.transfers
-    new.transfer_s = old.transfer_s
-    new.wait_s = old.wait_s
-    return new
-
-
 @functools.partial(
     jax.jit,
     static_argnames=("trainer", "discrete_mask", "cfg"),
@@ -260,104 +237,6 @@ def _wave_exploit(
     new_u, src_idx, _ = pbt_exploit_explore(key, unit, scores, disc, cfg)
     n_fail = jnp.sum(~jnp.isfinite(scores)).astype(jnp.int32)
     return new_u, src_idx, scores.max(), scores.mean(), n_fail, scores[src_idx]
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("trainer", "hparams_fn", "steps", "n_total"),
-    donate_argnames=("state",),
-)
-def _wave_train_program(
-    trainer, state, unit_slice, hparams_fn, train_x, train_y, key, steps, n_total, offset
-):
-    """One wave's training launch, with the unit->hparams mapping
-    applied IN-program. Applying it eagerly instead looks harmless but
-    is not: eager op-by-op kernels and fused XLA codegen disagree by
-    ~1e-7 relative on the log-uniform transforms, and the augmentation's
-    DISCRETE decisions (rounded shift offsets, bernoulli flips) amplify
-    an ulp of hparam difference into entirely different batches —
-    measured as 1e-2 param divergence within 4 steps. In-program hp is
-    what makes wave mode reproduce the resident scan bit-for-bit."""
-    hp = hparams_fn(unit_slice)
-    return type(trainer)._train_segment_window(
-        trainer, state, hp, train_x, train_y, key, steps, n_total, offset
-    )
-
-
-def _run_wave(
-    trainer,
-    pool,
-    rows,
-    offset: int,
-    unit,
-    hparams_fn,
-    train_x,
-    train_y,
-    val_x,
-    val_y,
-    k_train,
-    steps: int,
-    population: int,
-    mesh,
-    engine,
-    init_keys=None,
-    sample_x=None,
-):
-    """Stage in + train + eval ONE wave: members [offset, offset+W) of
-    the population. ``rows`` is the host-pool row index array and
-    already carries the previous generation's exploit source map, so
-    staging in IS the winner gather. Generation 0 passes ``init_keys``
-    instead (members don't exist yet — initializing on device skips a
-    pointless host round trip; the keys are the same
-    ``split(k_init, P)`` window the resident program would use, so the
-    weights are bit-identical). Module-level so crash-injection tests
-    can intercept it, like ``run_fused_pbt``."""
-    from mpi_opt_tpu.train.staging import stage_in, tree_bytes
-
-    # chaos seam (inject_oom): one guarded launch ordinal per wave —
-    # raises a synthetic RESOURCE_EXHAUSTED at the drilled wave, which
-    # the generation's oom_funnel classifies exactly like a real one
-    resources.launch_fault("wave")
-    w = len(rows)
-    if init_keys is not None:
-        st = trainer.init_members(init_keys, sample_x)
-        if mesh is not None:
-            from mpi_opt_tpu.parallel.mesh import shard_popstate
-
-            st = shard_popstate(st, mesh)
-    else:
-        with trace.span("stage_in", members=w) as sp:
-            dev = stage_in(pool, rows, mesh)
-            n_bytes = tree_bytes(dev)
-            sp["bytes"] = n_bytes
-            memory.note(sp)
-        engine.note_bytes(n_bytes)
-        st = PopState(params=dev["params"], momentum=dev["momentum"], step=dev["step"])
-    st, _ = _wave_train_program(
-        trainer,
-        st,
-        unit[offset : offset + w],
-        hparams_fn,
-        train_x,
-        train_y,
-        k_train,
-        steps,
-        population,
-        jnp.int32(offset),
-    )
-    scores = trainer.eval_population(st, val_x, val_y)
-    return st, scores
-
-
-def _writable(tree):
-    """Orbax restores may hand back read-only numpy arrays; the pools
-    are written in place per wave, so copy only the leaves that need it."""
-    import numpy as np
-
-    return jax.tree.map(
-        lambda l: l if isinstance(l, np.ndarray) and l.flags.writeable else np.array(l),
-        tree,
-    )
 
 
 def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gathers scores, exploits at generation boundaries)
@@ -435,16 +314,14 @@ def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gather
 
     from mpi_opt_tpu.parallel.mesh import fetch_global, place_pop
     from mpi_opt_tpu.train.common import HParamsFn
-    from mpi_opt_tpu.train.staging import StagingEngine, population_pool, write_rows
+    from mpi_opt_tpu.train.staging import population_pool, write_rows
     from mpi_opt_tpu.utils.checkpoint import SweepCheckpointer
 
     # the REQUESTED cap is the sweep's config identity (stable across
-    # resumes under the same flag); the EXECUTION cap below may shrink
-    # via OOM backoff, recorded per snapshot in meta (wave_size_run)
+    # resumes under the same flag); the EXECUTION cap (WaveRunner) may
+    # shrink via OOM backoff, recorded per snapshot in meta (wave_size_run)
     req_wave_size = wave_size
-    wave_lens, offs, n_waves = _wave_layout(population, wave_size)
-    oom_budget = max(0, int(oom_backoff))
-    n_backoffs = 0
+    wave_lens, _, _ = _wave_layout(population, wave_size)
     disc = tuple(bool(b) for b in space.discrete_mask())
     hparams_fn = HParamsFn(space, workload)
     key = jax.random.key(seed)
@@ -509,7 +386,6 @@ def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gather
             run_ws = int(meta.get("wave_size_run", wave_size))
             if run_ws != wave_size:
                 wave_size = run_ws
-                wave_lens, offs, n_waves = _wave_layout(population, wave_size)
             pool_front = _writable(sweep["front"])
             perm = np.asarray(sweep["perm"])
             unit = jnp.asarray(sweep["unit"])
@@ -546,7 +422,11 @@ def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gather
         unit = place_pop(unit, mesh)
 
     snapshot_every = max(1, snapshot_every)
-    engine = StagingEngine()
+    # the shared wave executor (train/engine.py) owns the StagingEngine,
+    # the execution cap, and the OOM-backoff retry loop; the generation
+    # loop below supplies only PBT's shapes (dispatch/payload/labels)
+    # and boundary op
+    runner = WaveRunner(population, wave_size, oom_backoff=oom_backoff)
     # per-generation FLOPs for the trace layer's achieved-TF/s (None
     # when tracing is off — the probe is never paid untraced)
     flops_gen = segment_flops_hint(workload, population, steps_per_gen)
@@ -574,171 +454,101 @@ def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gather
             # the carried-key chain matches run_fused_pbt.one_generation
             # exactly: next carry, train key, exploit key
             k_run, k_train, k_pbt = jax.random.split(k_gen, 3)
-            while True:  # one iteration per OOM-backoff attempt
-                wave_scores: list = [None] * n_waves
-                w0 = 0
-                if resumed_mid:
-                    w0 = start_wave
-                    for w in range(start_wave):
-                        off, wl_ = offs[w], wave_lens[w]
-                        # completed waves' scores round-trip exactly (f32)
-                        wave_scores[w] = jnp.asarray(scores_host[off : off + wl_])
-                def _train_generation(w0=w0, wave_scores=wave_scores):
-                    for w in range(w0, n_waves):
-                        off, wl_ = offs[w], wave_lens[w]
-                        st, sc = _run_wave(
-                            trainer,
-                            pool_front,
-                            perm[off : off + wl_],
-                            off,
-                            unit,
-                            hparams_fn,
-                            train_x,
-                            train_y,
-                            val_x,
-                            val_y,
-                            k_train,
-                            steps_per_gen,
-                            population,
-                            mesh,
-                            engine,
-                            init_keys=member_keys[off : off + wl_] if g == 0 else None,
-                            sample_x=train_x[:2],
-                        )
-                        wave_scores[w] = sc
-                        # per-wave liveness (ROADMAP follow-up): beat as soon as
-                        # the wave's programs are dispatched, so a stall timeout
-                        # sized to one wave also covers the generation's LAST
-                        # wave (whose next boundary beat waits on the full drain
-                        # + exploit)
-                        from mpi_opt_tpu.health import heartbeat
 
-                        heartbeat.beat(
-                            stage=f"pbt gen {g + 1}/{generations} wave "
-                            f"{w + 1}/{n_waves} dispatched"
-                        )
-                        # async stage-out: the background fetch blocks on THIS
-                        # wave's compute while the loop dispatches the next wave
-                        engine.stage_out(
-                            {
-                                "state": {
-                                    "params": st.params,
-                                    "momentum": st.momentum,
-                                    "step": st.step,
-                                },
-                                "scores": sc,
-                            },
-                            _writer(off),
-                        )
+            def _dispatch(w, off, wl_, eng, g=g, k_train=k_train):
+                # ``_run_wave`` resolved at call time (module global) so
+                # the chaos drills' monkeypatch seam keeps working
+                return _run_wave(
+                    trainer,
+                    pool_front,
+                    perm[off : off + wl_],
+                    off,
+                    unit,
+                    hparams_fn,
+                    train_x,
+                    train_y,
+                    val_x,
+                    val_y,
+                    k_train,
+                    steps_per_gen,
+                    population,
+                    mesh,
+                    eng,
+                    init_keys=member_keys[off : off + wl_] if g == 0 else None,
+                    sample_x=train_x[:2],
+                )
 
-                        def save_midgen(g=g, w=w):  # sweeplint: barrier(between-waves drain snapshot: fetches partial state for the checkpoint)
-                            engine.drain()  # pools must hold every completed wave
-                            # COPY the pools: orbax's save is async, and the live
-                            # buffers are mutated in place by later waves' stage-out
-                            # writers — handing them over uncopied can tear the
-                            # snapshot (same contract as the resident path's
-                            # host-fetch-before-save)
-                            snap.save(
-                                g * n_waves + w + 1,
-                                sweep={
-                                    "front": jax.tree.map(np.array, pool_front),
-                                    "back": jax.tree.map(np.array, pool_back),
-                                    "perm": np.asarray(perm),
-                                    "unit": fetch_global(unit),
-                                    "key_data": np.asarray(jax.random.key_data(k_gen)),
-                                    "scores": scores_host.copy(),
-                                },
-                                meta_extra={
-                                    "gen": g,
-                                    "waves_done": w + 1,
-                                    # a mid-generation snapshot completes no
-                                    # boundary: only g generations are journaled
-                                    "boundaries_done": g,
-                                    # the OOM-settled execution cap: waves_done
-                                    # counts waves of THIS split, and a resume
-                                    # must adopt it rather than re-OOM
-                                    "wave_size_run": wave_size,
-                                    "best": best_list,
-                                    "mean": mean_list,
-                                    "member_fail": fail_list,
-                                    "gen_walls": gen_walls,
-                                    "wall_partial": time.perf_counter() - t_gen + gen_partial0,
-                                },
-                            )
+            def _payload(st, sc):
+                return {
+                    "state": {
+                        "params": st.params,
+                        "momentum": st.momentum,
+                        "step": st.step,
+                    },
+                    "scores": sc,
+                }
 
-                        if w + 1 < n_waves:
-                            # between-waves service point: heartbeat + graceful
-                            # drain with a mid-generation snapshot (completed
-                            # waves are never re-trained on resume)
-                            launch_boundary(
-                                f"pbt gen {g + 1}/{generations} wave {w + 1}/{n_waves}",
-                                final=False,
-                                snapshot=None if snap is None else save_midgen,
-                                launch=g * n_waves + w + 1,
-                                of=generations * n_waves,
-                            )
-                    # generation boundary: the ONLY hard transfer barrier —
-                    # exploit needs the full score vector and a settled pool
-                    engine.drain()
+            def _stage_label(w, nw, g=g):
+                return f"pbt gen {g + 1}/{generations} wave {w + 1}/{nw}"
 
-                # the generation's train span covers every wave dispatch AND
-                # the drain barrier, so its duration is the generation's real
-                # compute+transfer wall; nested stage_in/stage_out/stage_wait/
-                # save spans subtract from its self time. ``flops`` makes the
-                # trace CLI report achieved TF/s per generation. The
-                # oom_funnel classifies an XLA RESOURCE_EXHAUSTED escaping
-                # any wave into typed DeviceOOM for the backoff below.
-                profiling.launch_tick()
-                try:
-                    with oom_funnel(wave_size):
-                        with trace.span(
-                            "train", launch=g + 1, gens=1, waves=n_waves
-                        ) as sp:
-                            _train_generation()
-                            # flops only AFTER the drain barrier completed: a
-                            # generation interrupted between waves emits its real
-                            # partial duration WITHOUT the attr, so the trace CLI
-                            # never divides full-generation FLOPs by partial wall
-                            if flops_gen:
-                                sp["flops"] = flops_gen
-                            # post-drain device-memory watermark: the generation's
-                            # peak residency (two waves + activations) just happened
-                            memory.note(sp)
-                    break
-                except resources.DeviceOOM as e:
-                    if oom_budget <= 0 or wave_size <= 1:
-                        # no wave left to halve (or backoff disabled):
-                        # the classified answer propagates — CLI exit 74
-                        raise
-                    oom_budget -= 1
-                    n_backoffs += 1
-                    # settle what completed; a transfer that died WITH
-                    # the OOM latched its error in the engine — roll it
-                    # over (accounting carried) so re-run stage-outs
-                    # aren't refused on sight
-                    try:
-                        engine.drain()
-                    # sweeplint: disable=drain-swallow -- settling in-flight transfers before the backoff re-run: the error here is the same already-classified OOM this handler is absorbing, and the engine is rolled over fresh below
-                    except BaseException:
-                        pass
-                    engine = _engine_rollover(engine)
-                    wave_size = max(1, wave_size // 2)
-                    wave_lens, offs, n_waves = _wave_layout(population, wave_size)
-                    # re-run THIS generation from wave 0 under the new
-                    # split: pool_front reads are non-destructive, the
-                    # generation's keys (k_train/k_pbt) are already
-                    # derived, and rewritten pool_back rows carry
-                    # identical values — bit-identity is preserved
-                    scores_host[:] = np.nan
-                    resumed_mid = False
-                    resources.notify(
-                        "oom_backoff",
-                        gen=g + 1,
-                        wave_size=wave_size,
-                        remaining=oom_budget,
-                        error=str(e)[:300],
+            def _boundary_kwargs(w, nw, g=g):
+                return {"launch": g * nw + w + 1, "of": generations * nw}
+
+            def _midgen_snapshot(w, nw, g=g):
+                def save_midgen():  # sweeplint: barrier(between-waves drain snapshot: fetches partial state for the checkpoint)
+                    runner.engine.drain()  # pools must hold every completed wave
+                    # COPY the pools: orbax's save is async, and the live
+                    # buffers are mutated in place by later waves' stage-out
+                    # writers — handing them over uncopied can tear the
+                    # snapshot (same contract as the resident path's
+                    # host-fetch-before-save)
+                    snap.save(
+                        g * nw + w + 1,
+                        sweep={
+                            "front": jax.tree.map(np.array, pool_front),
+                            "back": jax.tree.map(np.array, pool_back),
+                            "perm": np.asarray(perm),
+                            "unit": fetch_global(unit),
+                            "key_data": np.asarray(jax.random.key_data(k_gen)),
+                            "scores": scores_host.copy(),
+                        },
+                        meta_extra={
+                            "gen": g,
+                            "waves_done": w + 1,
+                            # a mid-generation snapshot completes no
+                            # boundary: only g generations are journaled
+                            "boundaries_done": g,
+                            # the OOM-settled execution cap: waves_done
+                            # counts waves of THIS split, and a resume
+                            # must adopt it rather than re-OOM
+                            "wave_size_run": runner.wave_size,
+                            "best": best_list,
+                            "mean": mean_list,
+                            "member_fail": fail_list,
+                            "gen_walls": gen_walls,
+                            "wall_partial": time.perf_counter() - t_gen + gen_partial0,
+                        },
                     )
-                    continue
+
+                return save_midgen
+
+            wave_scores = runner.run_interval(
+                n=population,
+                run_wave_fn=_dispatch,
+                payload_fn=_payload,
+                writer_fn=_writer,
+                scores_host=scores_host,
+                stage_label=_stage_label,
+                boundary_kwargs=_boundary_kwargs,
+                midpoint_snapshot=None if snap is None else _midgen_snapshot,
+                span_attrs=lambda nw, g=g: {"launch": g + 1, "gens": 1, "waves": nw},
+                flops=flops_gen,
+                start_wave=start_wave if resumed_mid else 0,
+                notify_fields=(("gen", g + 1),),
+            )
+            # the settled layout this generation actually ran under (an
+            # absorbed OOM halved it): boundary numbering + snapshot meta
+            n_waves = runner.n_waves
             # journal this generation's members (pre-exploit scores +
             # the units they trained with) BEFORE the boundary snapshot;
             # a resumed generation verifies instead of re-writing
@@ -751,7 +561,7 @@ def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gather
                 step=(g + 1) * steps_per_gen,
             )
             scores_dev = jnp.concatenate([jnp.asarray(s) for s in wave_scores])
-            with trace.span("boundary", op="exploit", gen=g + 1):
+            with boundary_span("exploit", gen=g + 1):
                 new_unit, src_idx, best, mean, n_fail, post = _wave_exploit(
                     k_pbt, unit, scores_dev, discrete_mask=disc, cfg=cfg
                 )
@@ -788,7 +598,7 @@ def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gather
                         "waves_done": 0,
                         "boundaries_done": g + 1,
                         # the OOM-settled execution cap (adopted on resume)
-                        "wave_size_run": wave_size,
+                        "wave_size_run": runner.wave_size,
                         "best": best_list,
                         "mean": mean_list,
                         "member_fail": fail_list,
@@ -808,7 +618,7 @@ def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gather
                 of=generations * n_waves,
             )
     finally:
-        engine.close()
+        runner.close()
         if snap is not None:
             snap.close()
 
@@ -833,20 +643,12 @@ def _fused_pbt_waves(  # sweeplint: barrier(wave host loop: stages pools, gather
         "launch_gens": [1] * generations,
         "launch_walls": [float(v) for v in gen_walls],
         # wave-scheduling observability (acceptance: staging must be
-        # visible, not inferred): bytes moved and how much of the
-        # transfer time the double buffer hid behind compute.
-        # wave_size/wave_lens are the EXECUTION split — after an OOM
-        # backoff they differ from the requested cap, which is the point
-        "wave_size": wave_size,
-        "wave_lens": list(wave_lens),
-        "n_waves": n_waves,
-        # device-OOM halvings absorbed this run (ISSUE 13): each one
-        # re-ran its generation at half the wave, bit-identically
-        "oom_backoffs": n_backoffs,
-        "staged_bytes": int(engine.staged_bytes),
-        "stage_transfer_s": float(engine.transfer_s),
-        "stage_wait_s": float(engine.wait_s),
-        "stage_overlap_s": float(engine.overlap_s),
+        # visible, not inferred) from the shared runner: the settled
+        # EXECUTION split (after an OOM backoff it differs from the
+        # requested cap, which is the point), halvings absorbed, bytes
+        # moved, and how much transfer time the double buffer hid
+        # behind compute
+        **runner.result_extras(),
         "journal": None
         if journal is None
         else {"written": journal.written, "verified": journal.verified},
@@ -891,7 +693,7 @@ def _run_stepped_generation(
         # beats, so launch.py's --stall-timeout can be sized to one
         # step_chunk instead of a whole generation's train_segment scan
         heartbeat.beat(stage=f"pbt train sub-launch {i + 1}/{len(sub_lens)}")
-    with trace.span("boundary", op="exploit"):
+    with boundary_span("exploit"):
         state, unit, best, mean, n_fail, gen_scores, pre_scores, pre_unit = (
             finish_generation(
                 trainer, state, unit, k_pbt, val_x, val_y, discrete_mask=disc, cfg=cfg
@@ -1040,62 +842,26 @@ def fused_pbt(  # sweeplint: barrier(resident host loop: launch boundaries, expl
     trainer, space, train_x, train_y, val_x, val_y = workload_arrays(
         workload, member_chunk, mesh
     )
-    # wave scheduling (population > residency): resolve the cap, then
-    # hand off to the host-staged driver. ``auto`` sizes the wave from
-    # a residency estimate; a cap at or above the population means
+    # wave scheduling (population > residency): resolve the cap through
+    # the shared engine door (``auto`` estimation, explicit pre-clamp,
+    # multi-process refusal — train/engine.py), then hand off to the
+    # host-staged driver. A cap at or above the population means
     # everything fits — resident mode, the bit-identical baseline.
     if wave_size:
-        from mpi_opt_tpu.train.staging import estimate_wave_size
-
-        was_auto = wave_size == "auto"
-        if was_auto:
-            wave_size = estimate_wave_size(trainer, train_x[:2], population, mesh)
-            if wave_size < population:
-                # the pre-launch headroom clamp engaged: auto sized the
-                # wave from the measured budget (or its fallbacks)
-                # BEFORE the first OOM — record it as an event, not a
-                # silent number (ISSUE 13)
-                resources.notify(
-                    "wave_resized",
-                    requested="auto",
-                    wave_size=int(wave_size),
-                    population=population,
-                )
-        wave_size = int(wave_size)
-        if wave_size < 0:
-            raise ValueError(f"wave_size must be >= 0, got {wave_size}")
-        if oom_backoff and not was_auto and 0 < wave_size < population:
-            from mpi_opt_tpu.obs import memory as obs_memory
-
-            # EXPLICIT cap vs MEASURED headroom (auto already sized
-            # from the estimate — re-deriving it here would compare the
-            # estimate against itself for a wasted eval_shape pass; and
-            # never clamp against the 8 GiB default — shrinking a
-            # hand-picked cap on a guess would surprise, the measured
-            # bytes_limit is evidence): shrink before the first OOM
-            # instead of paying one
-            if obs_memory.measured_budget() is not None:
-                est = estimate_wave_size(trainer, train_x[:2], population, mesh)
-                if est < wave_size:
-                    resources.notify(
-                        "wave_resized",
-                        requested=wave_size,
-                        wave_size=est,
-                        population=population,
-                    )
-                    wave_size = est
+        wave_size = resolve_wave_size(
+            trainer,
+            train_x[:2],
+            population,
+            wave_size=wave_size,
+            mesh=mesh,
+            oom_backoff=oom_backoff,
+        )
         if 0 < wave_size < population:
             if step_chunk > 0 or gen_chunk > 1:
                 raise ValueError(
                     "wave_size schedules whole generations as resident "
                     "waves; combining it with gen_chunk/step_chunk launch "
                     "splitting is ambiguous"
-                )
-            if jax.process_count() > 1:
-                raise ValueError(
-                    "wave scheduling stages members through THIS process's "
-                    "host memory; under multi-process SPMD shard the "
-                    "population over the mesh 'pop' axis instead"
                 )
             return _fused_pbt_waves(
                 workload,

@@ -37,6 +37,12 @@ from mpi_opt_tpu.train.common import (
     segment_flops_hint,
     workload_arrays,
 )
+from mpi_opt_tpu.train.engine import (
+    WaveRunner,
+    boundary_span,
+    resolve_wave_size,
+)
+from mpi_opt_tpu.train.engine import run_wave as _run_wave  # chaos-drill seam
 from mpi_opt_tpu.utils import profiling
 
 
@@ -85,6 +91,40 @@ def tpe_generation(
     return obs_unit, obs_scores, valid, key, scores, sugg
 
 
+@functools.partial(jax.jit, static_argnames=("n_suggest", "cfg"))
+def _tpe_suggest_program(obs_unit, obs_scores, valid, key, n_suggest: int, cfg):
+    """Wave mode's suggest boundary op: the SAME key split + acquisition
+    call ``tpe_generation`` opens with, as its own program. The buffers
+    are NOT donated — the ring is updated only after the batch's waves
+    have all landed (``_tpe_ring_update``), and an OOM-backoff re-run
+    must be able to replay the batch from these exact suggestions.
+    Separate-jit boundary ops preserve CPU bit-identity with the fused
+    program (the engine's ``_wave_exploit`` precedent), so wave-mode
+    suggestions equal resident-mode ones bit for bit."""
+    key, k_sug, k_init, k_train = jax.random.split(key, 4)
+    sugg, _ = tpe_suggest(k_sug, obs_unit, obs_scores, valid, n_suggest, cfg)
+    return key, k_init, k_train, sugg
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_suggest",),
+    donate_argnames=("obs_unit", "obs_scores", "valid"),
+)
+def _tpe_ring_update(obs_unit, obs_scores, valid, sugg, scores, write_pos, n_suggest: int):
+    """The tail of ``tpe_generation`` — writing a completed batch's
+    (units, scores) into the observation ring — split out so wave mode
+    runs it once per batch, after the wave scores are gathered. f32
+    scores round-trip host staging exactly, so the buffer after this
+    equals the resident program's in-place update bit for bit."""
+    obs_unit = jax.lax.dynamic_update_slice(obs_unit, sugg, (write_pos, 0))
+    obs_scores = jax.lax.dynamic_update_slice(obs_scores, scores, (write_pos,))
+    valid = jax.lax.dynamic_update_slice(
+        valid, jnp.ones((n_suggest,), bool), (write_pos,)
+    )
+    return obs_unit, obs_scores, valid
+
+
 def fused_tpe(  # sweeplint: barrier(batch host loop: fetches obs ring for snapshot/journal at batch boundaries)
     workload,
     n_trials: int,
@@ -94,12 +134,24 @@ def fused_tpe(  # sweeplint: barrier(batch host loop: fetches obs ring for snaps
     cfg: TPEConfig = TPEConfig(),
     member_chunk: int = 0,
     mesh=None,
+    wave_size=0,
+    oom_backoff: int = 2,
     checkpoint_dir: str = None,
     ledger=None,
     warm_obs=None,
 ):
     """Run an n_trials TPE sweep as ceil(n_trials/batch) fused
     generations (the last one sized to the remainder).
+
+    ``wave_size`` (int or ``'auto'``) runs each generation's cohort as
+    resident waves through the shared engine (train/engine.py) when the
+    batch exceeds device residency: the suggest step runs as its own
+    boundary program, each wave initializes its members from the SAME
+    ``split(k_init, batch)`` key window the resident program would use,
+    and only scores stage back out (TPE carries no state between
+    generations) — bit-identical to resident mode at any wave size.
+    ``oom_backoff`` halves the wave cap and replays the generation from
+    its already-drawn suggestions on a classified device OOM.
 
     ``ledger`` journals one record per suggestion per generation batch
     (unit params + score at the trial budget) before the generation's
@@ -134,6 +186,17 @@ def fused_tpe(  # sweeplint: barrier(batch host loop: fetches obs ring for snaps
     sizes = [batch] * (n_trials // batch)
     if n_trials % batch:
         sizes.append(n_trials % batch)
+    # the residency question is about the LARGEST generation cohort;
+    # the engine re-lays out smaller (remainder) generations per batch
+    wave_size = resolve_wave_size(
+        trainer,
+        train_x[:2],
+        max(sizes),
+        wave_size=wave_size,
+        mesh=mesh,
+        oom_backoff=oom_backoff,
+    )
+    waves = 0 < wave_size < max(sizes)
     # finite-scored priors only: a diverged prior point carries no
     # evidence the model should build on (same rule as driver ingest)
     warm = [o for o in (warm_obs or []) if np.isfinite(float(o.score))]
@@ -168,6 +231,7 @@ def fused_tpe(  # sweeplint: barrier(batch host loop: fetches obs ring for snaps
     snap = None
     restored = None
     start_gen = 0
+    run_wave_size = wave_size  # execution cap; adopted from snapshot meta
     done = n_warm  # write position: live trials append after the priors
     best_curve = []
     member_fail: list = []  # per-gen diverged-suggestion counts
@@ -195,6 +259,12 @@ def fused_tpe(  # sweeplint: barrier(batch host loop: fetches obs ring for snaps
                 # every live write position): resuming under a
                 # different prior set must refuse, not corrupt
                 "n_warm": n_warm,
+                # wave mode's REQUESTED cap is config identity (the
+                # OOM-settled execution cap travels in per-snapshot
+                # meta); resident configs deliberately DON'T write the
+                # key, so pre-wave snapshots keep resuming via the
+                # checkpointer's setdefault back-compat
+                **({"wave_size": wave_size} if waves else {}),
             },
         )
         restored = snap.restore()
@@ -215,6 +285,8 @@ def fused_tpe(  # sweeplint: barrier(batch host loop: fetches obs ring for snaps
                 member_fail = [int(v) for v in meta["member_fail"]]
             else:
                 fails_complete = False
+            if waves:
+                run_wave_size = int(meta.get("wave_size_run", wave_size))
 
     from mpi_opt_tpu.parallel.mesh import fetch_global
 
@@ -229,8 +301,12 @@ def fused_tpe(  # sweeplint: barrier(batch host loop: fetches obs ring for snaps
     journal_require_prefix(journal, start_gen)
     # a fused journal forces the eager path (its per-batch records must
     # be fsync-durable before the batch's snapshot — deferral breaks
-    # the ordering contract), same as a checkpoint does
-    defer = snap is None and journal is None
+    # the ordering contract), same as a checkpoint does; wave mode's
+    # scores land on the host per batch anyway, so its curve is eager
+    defer = snap is None and journal is None and not waves
+    runner = None
+    if waves:
+        runner = WaveRunner(max(sizes), run_wave_size, oom_backoff=oom_backoff)
     # warm prior rows are facts, not trials of THIS sweep: bar them
     # from the running-best curve and the final winner pick
     live = jnp.arange(M) >= n_warm
@@ -238,60 +314,152 @@ def fused_tpe(  # sweeplint: barrier(batch host loop: fetches obs ring for snaps
     fail_dev: list = []
     try:
         for g in range(start_gen, len(sizes)):
-            profiling.launch_tick()
-            # eager mode's curve fetch is the batch's completion barrier
-            # (real duration -> flops attr for achieved TF/s); deferred
-            # mode dispatches async, so the span carries no flops. The
-            # hint probes OUTSIDE the span (one-time cost must not
-            # inflate the first batch's duration), attaches only after
-            # the barrier (a crashed batch must not report full-batch
-            # FLOPs over a partial duration).
-            f = None if defer else segment_flops_hint(workload, sizes[g], budget)
-            with trace.span(
-                "train", launch=g + 1, members=sizes[g], steps=budget
-            ) as sp:
-                obs_unit, obs_scores, valid, key, scores, sugg = tpe_generation(
-                    trainer,
-                    obs_unit,
-                    obs_scores,
-                    valid,
-                    hparams_fn,
-                    train_x,
-                    train_y,
-                    val_x,
-                    val_y,
-                    key,
-                    jnp.int32(done),
-                    n_suggest=sizes[g],
-                    budget=budget,
-                    cfg=cfg,
+            n_g = sizes[g]
+            if waves:
+                # engine path: suggest as its own boundary program, the
+                # cohort as resident waves (scores-only stage-out — TPE
+                # carries no state between generations), the ring update
+                # once the batch's scores have all landed. The runner
+                # owns launch_tick, the train span, the per-wave
+                # heartbeats, the drain barrier, and the OOM-backoff
+                # replay (the replay re-trains from the SAME suggestions
+                # and init keys, so it is bit-identical).
+                with boundary_span("suggest", generation=g + 1, n=n_g):
+                    key, k_init, k_train, sugg = _tpe_suggest_program(
+                        obs_unit, obs_scores, valid, key, n_g, cfg
+                    )
+                member_keys = jax.random.split(k_init, n_g)
+                scores_host = np.full((n_g,), np.nan, np.float32)
+
+                def _dispatch(
+                    w, off, wl_, eng,
+                    k_train=k_train, sugg=sugg, member_keys=member_keys, n_g=n_g,
+                ):
+                    return _run_wave(
+                        trainer,
+                        None,
+                        np.arange(off, off + wl_),
+                        off,
+                        sugg,
+                        hparams_fn,
+                        train_x,
+                        train_y,
+                        val_x,
+                        val_y,
+                        k_train,
+                        budget,
+                        n_g,
+                        mesh,
+                        eng,
+                        init_keys=member_keys[off : off + wl_],
+                        sample_x=train_x[:2],
+                    )
+
+                def _payload(st, sc):
+                    return {"scores": sc}
+
+                def _writer(off, scores_host=scores_host):
+                    def _write(host_tree):  # sweeplint: barrier(stage-out landing: writes fetched wave scores into the batch accumulator)
+                        s = host_tree["scores"]
+                        scores_host[off : off + len(s)] = s
+
+                    return _write
+
+                f = segment_flops_hint(workload, n_g, budget)
+                runner.run_interval(
+                    n=n_g,
+                    run_wave_fn=_dispatch,
+                    payload_fn=_payload,
+                    writer_fn=_writer,
+                    scores_host=scores_host,
+                    stage_label=lambda w, nw, g=g: (
+                        f"tpe generation {g + 1}/{len(sizes)} wave {w + 1}/{nw}"
+                    ),
+                    boundary_kwargs=lambda w, nw, g=g: {
+                        "generation": g + 1,
+                        "of": len(sizes),
+                    },
+                    span_attrs=lambda nw, g=g, n_g=n_g: {
+                        "launch": g + 1,
+                        "members": n_g,
+                        "steps": budget,
+                        "waves": nw,
+                    },
+                    flops=f,
+                    notify_fields=(("generation", g + 1),),
                 )
-                done += sizes[g]
-                # valid alone is not enough: one valid-but-NaN observation
-                # would propagate through jnp.max into every later curve
-                # point — gate on finiteness too (same rule as best_i below)
+                # f32 round-trips host staging exactly: this equals the
+                # device scores tpe_generation would have produced
+                scores = jnp.asarray(scores_host.copy())
+                with boundary_span("observe", generation=g + 1):
+                    obs_unit, obs_scores, valid = _tpe_ring_update(
+                        obs_unit, obs_scores, valid, sugg, scores,
+                        jnp.int32(done), n_g,
+                    )
+                done += n_g
                 running_dev = jnp.max(
                     jnp.where(
                         valid & jnp.isfinite(obs_scores) & live, obs_scores, -jnp.inf
                     )
                 )
-                # this generation's diverged-suggestion count (ROADMAP open
-                # item): the obs ring masks non-finite scores from the model,
-                # but operators need the tally the masking hides
                 fail_dev_g = jnp.sum(~jnp.isfinite(scores)).astype(jnp.int32)
-                if defer:
-                    curve_dev.append(running_dev)
-                    fail_dev.append(fail_dev_g)
-                else:
-                    # fetch_global: under multi-process SPMD the buffer is a
-                    # process-spanning (replicated) global array
-                    best_curve.append(float(fetch_global(running_dev)))
-                    member_fail.append(int(fetch_global(fail_dev_g)))
-                    if f:
-                        sp["flops"] = f
-                    # post-barrier device-memory watermark: batch cohort
-                    # + obs ring resident
-                    memory.note(sp)
+                best_curve.append(float(fetch_global(running_dev)))
+                member_fail.append(int(fetch_global(fail_dev_g)))
+            else:
+                profiling.launch_tick()
+                # eager mode's curve fetch is the batch's completion barrier
+                # (real duration -> flops attr for achieved TF/s); deferred
+                # mode dispatches async, so the span carries no flops. The
+                # hint probes OUTSIDE the span (one-time cost must not
+                # inflate the first batch's duration), attaches only after
+                # the barrier (a crashed batch must not report full-batch
+                # FLOPs over a partial duration).
+                f = None if defer else segment_flops_hint(workload, sizes[g], budget)
+                with trace.span(
+                    "train", launch=g + 1, members=sizes[g], steps=budget
+                ) as sp:
+                    obs_unit, obs_scores, valid, key, scores, sugg = tpe_generation(
+                        trainer,
+                        obs_unit,
+                        obs_scores,
+                        valid,
+                        hparams_fn,
+                        train_x,
+                        train_y,
+                        val_x,
+                        val_y,
+                        key,
+                        jnp.int32(done),
+                        n_suggest=sizes[g],
+                        budget=budget,
+                        cfg=cfg,
+                    )
+                    done += sizes[g]
+                    # valid alone is not enough: one valid-but-NaN observation
+                    # would propagate through jnp.max into every later curve
+                    # point — gate on finiteness too (same rule as best_i below)
+                    running_dev = jnp.max(
+                        jnp.where(
+                            valid & jnp.isfinite(obs_scores) & live, obs_scores, -jnp.inf
+                        )
+                    )
+                    # this generation's diverged-suggestion count (ROADMAP open
+                    # item): the obs ring masks non-finite scores from the model,
+                    # but operators need the tally the masking hides
+                    fail_dev_g = jnp.sum(~jnp.isfinite(scores)).astype(jnp.int32)
+                    if defer:
+                        curve_dev.append(running_dev)
+                        fail_dev.append(fail_dev_g)
+                    else:
+                        # fetch_global: under multi-process SPMD the buffer is a
+                        # process-spanning (replicated) global array
+                        best_curve.append(float(fetch_global(running_dev)))
+                        member_fail.append(int(fetch_global(fail_dev_g)))
+                        if f:
+                            sp["flops"] = f
+                        # post-barrier device-memory watermark: batch cohort
+                        # + obs ring resident
+                        memory.note(sp)
             if journal is not None:
                 # one record per suggestion of this batch (members are
                 # the sweep's global trial indices), journaled BEFORE
@@ -322,6 +490,9 @@ def fused_tpe(  # sweeplint: barrier(batch host loop: fetches obs ring for snaps
                         "boundaries_done": g + 1,
                         "best_curve": best_curve,
                         **({"member_fail": member_fail} if fails_complete else {}),
+                        # the OOM-settled execution cap: a resume adopts
+                        # it instead of re-paying the halvings
+                        **({"wave_size_run": runner.wave_size} if waves else {}),
                     },
                 )
             # heartbeat + graceful-shutdown drain: checkpointed sweeps
@@ -334,6 +505,8 @@ def fused_tpe(  # sweeplint: barrier(batch host loop: fetches obs ring for snaps
                 of=len(sizes),
             )
     finally:
+        if runner is not None:
+            runner.close()
         if snap is not None:
             snap.close()
 
@@ -370,4 +543,5 @@ def fused_tpe(  # sweeplint: barrier(batch host loop: fetches obs ring for snaps
         "journal": None
         if journal is None
         else {"written": journal.written, "verified": journal.verified},
+        **({} if runner is None else runner.result_extras()),
     }

@@ -27,11 +27,14 @@ Three pieces:
 - Host pool helpers: the cold population lives as one numpy pytree with
   a leading [P] member axis (``population_pool``, built from abstract
   member shapes); waves slice rows out (``stage_in``) and the engine
-  writes trained rows back (``write_rows``). Two pools ping-pong per generation (read the
-  previous generation's states while writing this generation's), which
-  is what lets the NEXT generation's stage-in apply the exploit
-  source-index map lazily — the winner gather becomes an indexed read,
-  not an extra full-population copy.
+  writes trained rows back (``write_rows``). Two pools ping-pong per
+  boundary (read the previous generation's/rung's states while writing
+  this one's), which is what lets the NEXT boundary's stage-in apply
+  the algorithm's survivor/winner index map lazily — PBT's exploit
+  gather and SHA's rung-cut gather both become an indexed read, not an
+  extra full-population copy. The per-algorithm wave loops live in
+  train/engine.py (the shared fused engine); this module stays the
+  transport + pool layer.
 
 - ``estimate_wave_size``: the ``--wave-size auto`` residency estimate —
   per-member params+momentum bytes from ``jax.eval_shape`` (no compute,

@@ -534,7 +534,8 @@ class SweepCheckpointer:
           NaN past the completed prefix).
 
         Key wrapping and pool writability (orbax may restore read-only
-        arrays) are the caller's job — see train/fused_pbt.py.
+        arrays) are the caller's job — see train/fused_pbt.py and the
+        shared wave engine's ``writable`` helper (train/engine.py).
         """
         return self.restore()
 

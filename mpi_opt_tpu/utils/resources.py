@@ -11,10 +11,11 @@ retry) or tore state. Both become typed ANSWERS here:
 
 - :class:`DeviceOOM` — an XLA ``RESOURCE_EXHAUSTED`` launch failure.
   Deterministic for a given program + population: retrying the same
-  shape re-OOMs, so supervisors must not fund restarts. The wave
-  scheduler's adaptive backoff (train/fused_pbt.py ``--oom-backoff``)
-  is the one productive response: halve the wave and re-run — wave mode
-  is bit-identical at any wave size, so backoff preserves the result.
+  shape re-OOMs, so supervisors must not fund restarts. The shared wave
+  engine's adaptive backoff (train/engine.py ``--oom-backoff``, every
+  fused algorithm) is the one productive response: halve the wave and
+  re-run the boundary — wave mode is bit-identical at any wave size,
+  so backoff preserves the result.
 - :class:`StorageFull` — ENOSPC/EDQUOT from a durable-state write.
   Also an answer, not weather: the snapshot layer gets ONE
   retention-prune retry (utils/checkpoint.py), then the run parks with

@@ -20,6 +20,7 @@
 #        T1_SKIP_LINT_DRILL=1 probes/tier1.sh # skip the sweeplint drill
 #        T1_SKIP_RACE_DRILL=1 probes/tier1.sh # skip the racelint/lock-order drill
 #        T1_SKIP_OOM_DRILL=1 probes/tier1.sh # skip the device-OOM backoff drill
+#        T1_SKIP_ENGINE_DRILL=1 probes/tier1.sh # skip the shared-engine chaos drill
 #        T1_SKIP_ENOSPC_DRILL=1 probes/tier1.sh # skip the disk-full drill
 #        T1_SKIP_CORPUS_DRILL=1 probes/tier1.sh # skip the corpus/auto-warm-start drill
 #        T1_SKIP_FRONTDOOR_DRILL=1 probes/tier1.sh # skip the HTTP front-door drill
@@ -445,6 +446,65 @@ PYEOF
         echo "OOM_DRILL=pass"
     else
         echo "OOM_DRILL=FAIL"
+        rc=$(( rc == 0 ? 1 : rc ))
+    fi
+fi
+
+# -- shared-engine chaos drill (train/engine.py, all-algorithm waves) --
+# The OOM drill above exercises PBT; this one proves the SAME engine
+# contracts hold for the other boundary ops. (a) Fused SHA in wave
+# mode with a RESOURCE_EXHAUSTED injected at its second rung must
+# complete via wave-halving with a ledger record-identical to an
+# unfaulted wave run's. (b) Fused TPE's wave mode must be
+# record-identical to its resident mode (the bit-identity that makes
+# the backoff safe, checked at the ledger). Both ledgers must pass
+# report --validate.
+if [ -z "$T1_SKIP_ENGINE_DRILL" ]; then
+    eg_rc=0
+    GD=$(mktemp -d /tmp/_t1_engine.XXXXXX)
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python - "$GD" >/dev/null 2>&1 <<'PYEOF' || eg_rc=1
+import json, sys
+from mpi_opt_tpu.cli import main
+d = sys.argv[1]
+keep = ("trial_id", "member", "boundary", "params", "status", "score", "step")
+rec = lambda p: [{k: r.get(k) for k in keep}
+                 for r in map(json.loads, open(p).read().splitlines()[1:])]
+
+# (a) SHA rung-cut boundary: OOM at the second rung's wave (launch 3:
+# rung 1 runs two waves of 4, rung 2's single wave is ordinal 3)
+sha = ["--workload", "fashion_mlp", "--algorithm", "asha", "--fused",
+       "--no-mesh", "--trials", "8", "--min-budget", "2",
+       "--max-budget", "4", "--eta", "2", "--seed", "0",
+       "--wave-size", "4"]
+assert main(sha + ["--ledger", f"{d}/sha_clean.jsonl"]) == 0
+from mpi_opt_tpu.workloads.chaos import inject_oom
+inj, un = inject_oom(at_launch=3, kind="wave")
+try:
+    assert main(sha + ["--ledger", f"{d}/sha_oom.jsonl",
+                       "--oom-backoff", "2"]) == 0
+finally:
+    un()
+assert inj.faults_fired == 1, inj.faults_fired
+assert rec(f"{d}/sha_clean.jsonl") == rec(f"{d}/sha_oom.jsonl"), "sha diverged"
+
+# (b) TPE re-suggest boundary: waves must be invisible in the record
+tpe = ["--workload", "fashion_mlp", "--algorithm", "tpe", "--fused",
+       "--no-mesh", "--trials", "8", "--population", "4", "--budget", "2",
+       "--seed", "0"]
+assert main(tpe + ["--ledger", f"{d}/tpe_res.jsonl"]) == 0
+assert main(tpe + ["--ledger", f"{d}/tpe_wave.jsonl",
+                   "--wave-size", "2"]) == 0
+assert rec(f"{d}/tpe_res.jsonl") == rec(f"{d}/tpe_wave.jsonl"), "tpe diverged"
+PYEOF
+    for L in sha_clean sha_oom tpe_res tpe_wave; do
+        timeout -k 10 120 env JAX_PLATFORMS=cpu python -m mpi_opt_tpu \
+            report --validate "$GD/$L.jsonl" >/dev/null 2>&1 || eg_rc=1
+    done
+    rm -rf "$GD"
+    if [ $eg_rc -eq 0 ]; then
+        echo "ENGINE_DRILL=pass"
+    else
+        echo "ENGINE_DRILL=FAIL"
         rc=$(( rc == 0 ? 1 : rc ))
     fi
 fi

@@ -158,6 +158,32 @@ def test_optional_scores_field_absent_valid_mistyped_flagged():
     assert validate_bench_record(legacy) == []
 
 
+def test_wave_sha_config_record_shape_validates():
+    """ISSUE 18: bench config 9 (wave-scheduled fused SHA) rides the
+    v2 shape with the engine's staging counters as plain extra keys —
+    the validator must accept them (extras are informational, never
+    drift) and the gate must judge the headline like any throughput
+    metric."""
+    rec = _v2(
+        config=9,
+        metric="wave_sha64_fashion_mlp_trials_per_sec_per_chip",
+        value=12.0,
+        wave_size=16,
+        n_waves=4,
+        staged_bytes=1 << 26,
+        stage_transfer_s=1.25,
+        stage_wait_s=0.2,
+        stage_overlap_s=1.0,
+    )
+    assert validate_bench_record(rec) == []
+    # throughput direction: a big drop in trials/s gates
+    worse = dict(rec, value=6.0)
+    rep = bench_gate([rec], [worse], {})
+    assert not rep["ok"]
+    rep = bench_gate([rec], [rec], {})
+    assert rep["ok"], rep["violations"]
+
+
 def test_committed_bench_history_stays_valid():
     """BENCH_r01-r05 predate the schema_version field: they must
     validate as the legacy shape forever (the trajectory's early rounds

@@ -981,13 +981,32 @@ def test_cli_wave_size_validation(capsys):
         base + ["--fused", "--wave-size", "-1"],
         base + ["--fused", "--wave-size", "4", "--step-chunk", "2"],
         base + ["--fused", "--wave-size", "4", "--gen-chunk", "2"],
-        ["--workload", "fashion_mlp", "--algorithm", "tpe", "--fused",
+        # any algorithm is wave-capable now, but only under --fused
+        ["--workload", "fashion_mlp", "--algorithm", "tpe",
+         "--wave-size", "4"],
+        ["--workload", "fashion_mlp", "--algorithm", "asha",
          "--wave-size", "4"],
     ):
         with pytest.raises(SystemExit) as ei:
             main(argv)
         assert ei.value.code == 2
         capsys.readouterr()
+
+
+def test_cli_fused_sha_wave_summary_surfaces_staging(capsys):
+    """--wave-size is no longer PBT-only: a fused SHA sweep accepts it
+    and its summary carries the same staging observability block."""
+    rc = main([
+        "--workload", "fashion_mlp", "--algorithm", "asha", "--fused",
+        "--trials", "8", "--min-budget", "2", "--max-budget", "4",
+        "--eta", "2", "--wave-size", "4", "--no-mesh", "--seed", "0",
+    ])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    summary = json.loads(lines[-1])
+    assert summary["wave_size"] == 4
+    assert summary["staged_bytes"] > 0
+    assert summary["rung_sizes"][0] == 8
 
 
 def test_cli_fused_wave_summary_surfaces_staging(capsys):

@@ -218,7 +218,10 @@ def test_fused_step_chunk_sub_launches_beat(tmp_path):
         )
     finally:
         heartbeat.deconfigure()
-    # per generation: 2 sub-launch beats + the launch-boundary beat
-    assert hb.beats == 2 * (2 + 1)
+    # per generation: 2 sub-launch beats, the shared engine's
+    # wave-dispatched beat (resident mode is the one-wave case of
+    # train/engine.py's interval loop), and the exploit boundary_span
+    # beat — so --stall-timeout can still be sized to one step_chunk
+    assert hb.beats == 2 * (2 + 1 + 1)
     rec = heartbeat.read_beat(hb_path)
     assert rec is not None and rec["beats"] == hb.beats

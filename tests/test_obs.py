@@ -202,6 +202,30 @@ def test_heartbeat_phase_from_active_span(tmp_path):
     assert outside["phase"] is None  # no active span anywhere
 
 
+def test_heartbeat_phase_carries_boundary_op(tmp_path):
+    """Boundary spans fold their ``op`` attribute into the heartbeat
+    phase (ISSUE 18 satellite): a stall during SHA's rung cut reads
+    "stalled during boundary:rung_cut" in the launch event, not just
+    "boundary" — the engine's boundary_span helper beats on entry so
+    the phase is fresh even if the boundary op itself wedges."""
+    from mpi_opt_tpu.health import heartbeat
+    from mpi_opt_tpu.train.engine import boundary_span
+
+    hb = str(tmp_path / "hb.json")
+    heartbeat.configure(hb)
+    try:
+        with boundary_span("rung_cut", rung=2):
+            cut = heartbeat.read_beat(hb)  # beat happens on span entry
+        with trace.span("boundary", op="exploit"):
+            heartbeat.beat(stage="gen 3")
+        exploit = heartbeat.read_beat(hb)
+    finally:
+        heartbeat.deconfigure()
+    assert cut["phase"] == "boundary:rung_cut"
+    assert cut["progress"]["stage"] == "boundary rung_cut"
+    assert exploit["phase"] == "boundary:exploit"
+
+
 def test_launch_stall_phases_from_beat_files(tmp_path):
     """launch.py's stall event includes each wedged rank's last-beat
     phase (active-span field, progress-stage fallback)."""
