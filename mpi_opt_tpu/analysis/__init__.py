@@ -47,6 +47,7 @@ def all_checkers():
         LockOrderChecker,
         SignalSafetyChecker,
     )
+    from mpi_opt_tpu.analysis.checkers_coord import CoordWriteChecker
     from mpi_opt_tpu.analysis.checkers_corpus import CorpusIndexWriteChecker
     from mpi_opt_tpu.analysis.checkers_drain import DrainSwallowChecker
     from mpi_opt_tpu.analysis.checkers_durability import (
@@ -73,6 +74,7 @@ def all_checkers():
         HostSyncChecker(),
         EventRegistryChecker(),
         LeaseWriteChecker(),
+        CoordWriteChecker(),
         CorpusIndexWriteChecker(),
         ResourceFunnelChecker(),
         FsyncBeforeRenameChecker(),

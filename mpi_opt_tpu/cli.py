@@ -470,6 +470,54 @@ def build_parser() -> argparse.ArgumentParser:
         "supervisor wires this per rank automatically; set manually "
         "for external watchdogs",
     )
+    # multi-process SPMD boundary agreement (parallel/coord.py): every
+    # rank-divergent decision (drain, wave cap, OOM halving) votes
+    # through a filesystem control plane and becomes unanimous before
+    # the next collective. launch.py owns these per rank, like
+    # --coordinator/--heartbeat-file
+    p.add_argument(
+        "--coord-dir",
+        default=None,
+        metavar="DIR",
+        help="multi-process SPMD: directory of the boundary-agreement "
+        "control plane (per-rank vote files, rank-0 decisions). "
+        "launch.py wires this per rank automatically; set manually "
+        "only for external supervisors. Single-process runs may set it "
+        "too (a world-of-1 plane agrees with itself — useful for "
+        "protocol drills)",
+    )
+    p.add_argument(
+        "--coord-epoch",
+        type=int,
+        default=0,
+        metavar="N",
+        help="with --coord-dir: the job attempt's vote namespace. Each "
+        "coordinated restart must use a FRESH epoch (launch.py passes "
+        "its relaunch counter) — a reused epoch is refused, stale "
+        "votes from a killed attempt must be unreadable",
+    )
+    p.add_argument(
+        "--coord-timeout",
+        type=float,
+        default=300.0,
+        metavar="SECONDS",
+        help="with --coord-dir: how long a rank waits at an agreement "
+        "boundary for its peers before declaring the collective wedged "
+        "(CoordWedged -> nonzero exit -> the supervisor's coordinated "
+        "restart). Size above the longest legitimate gap between "
+        "boundaries, like --stall-timeout",
+    )
+    p.add_argument(
+        "--rank-kill",
+        default=None,
+        metavar="SPEC",
+        help="chaos drill: SIGKILL a chosen rank at a chosen boundary "
+        "— 'rank=R,at=K[,n=N][,marker=PATH]' dies hard at the K-th "
+        "(1-based) launch/rung/generation boundary on the rank whose "
+        "process index is R. marker makes the kill one-shot across "
+        "coordinated restarts (fire only if PATH does not exist). "
+        "Exercises the collective-wedge escalation end to end",
+    )
     # the suggestion service (corpus/serve.py): instead of running a
     # sweep, answer suggest/report/lookup traffic for EXTERNAL sweeps
     p.add_argument(
@@ -821,8 +869,9 @@ def run_fused(args, parser, workload) -> int:
             parser.error(
                 "--retries requires a single-process run; under "
                 "multi-process SPMD recovery is a coordinated job "
-                "restart (re-launch with the same --checkpoint-dir to "
-                "resume)"
+                "restart — run under `python -m mpi_opt_tpu.launch "
+                "--retries N`, which relaunches ALL ranks with "
+                "--resume and a fresh --coord-epoch"
             )
     # resuming is explicit opt-in, matching the driver path: a stale
     # checkpoint dir must not silently replay an old sweep (ADVICE r2)
@@ -867,6 +916,31 @@ def run_fused(args, parser, workload) -> int:
     _wire_integrity_observer(metrics)
     _wire_resource_observer(metrics)
     _wire_trace(args, metrics)  # restored by main's finally
+    # boundary-agreement control plane (multi-process SPMD): activate
+    # the plane and chain its drain agreement onto the slice hook
+    # BEFORE any boundary runs; torn down in the finally below so no
+    # hook/plane leaks into in-process callers' next sweep
+    from mpi_opt_tpu.parallel import coord as _coord
+
+    coord_uninstall = None
+    if getattr(args, "coord_dir", None):
+        import jax
+
+        plane = _coord.CoordPlane(
+            args.coord_dir,
+            jax.process_index(),
+            jax.process_count(),
+            epoch=getattr(args, "coord_epoch", 0) or 0,
+            timeout_s=getattr(args, "coord_timeout", None) or 300.0,
+        )
+        coord_uninstall = _coord.install_hook(plane)
+    rank_kill_uninstall = None
+    if getattr(args, "rank_kill", None):
+        from mpi_opt_tpu.workloads.chaos import inject_rank_kill, parse_rank_kill_spec
+
+        _, rank_kill_uninstall = inject_rank_kill(
+            **parse_rank_kill_spec(args.rank_kill)
+        )
     from mpi_opt_tpu.ledger import LedgerError
 
     space = workload.default_space()
@@ -956,7 +1030,20 @@ def run_fused(args, parser, workload) -> int:
             file=sys.stderr,
         )
         return EX_TEMPFAIL
+    except _coord.CoordWedged as e:
+        # a peer never reached this rank's agreement boundary — the
+        # collective is wedged, and only a COORDINATED restart (the
+        # launch.py supervisor relaunching every rank with --resume and
+        # a fresh epoch) can recover. Exit nonzero-generic so the
+        # supervisor funds exactly that from its retry budget.
+        metrics.summary(final=True)
+        print(f"collective wedge: {e}", file=sys.stderr)
+        return 1
     finally:
+        if rank_kill_uninstall is not None:
+            rank_kill_uninstall()
+        if coord_uninstall is not None:
+            coord_uninstall()
         if ledger is not None:
             ledger.close()
 

@@ -192,9 +192,20 @@ def set_slice_hook(fn: Optional[Callable[[str], None]]) -> None:
     BEFORE the drain flag is checked, so a hook that decides the slice
     budget is spent can ``request()`` and have the very same boundary
     honor it. The hook must be cheap and must not raise — it runs on
-    the sweep's hot host path."""
+    the sweep's hot host path — with ONE sanctioned exception:
+    ``parallel/coord.py``'s boundary agreement chains onto this hook
+    and may raise ``CoordWedged`` when a peer rank never reaches the
+    boundary; that is a deliberate process-fatal verdict (exit, let
+    the supervisor restart the world), not hot-path work."""
     global _SLICE_HOOK
     _SLICE_HOOK = fn
+
+
+def get_slice_hook() -> Optional[Callable[[str], None]]:
+    """The currently installed slice hook (None without one) — for
+    wrappers like the coord plane's drain agreement that chain onto an
+    existing scheduler hook instead of displacing it."""
+    return _SLICE_HOOK
 
 
 def clear_slice_hook() -> None:

@@ -12,8 +12,11 @@ be a COORDINATED job restart. This module is that coordination, and the
         --checkpoint-dir /ckpt/sweep --population 1024 ...
 
 It spawns ``--n-proc`` ranks of ``python -m mpi_opt_tpu`` (appending
-``--coordinator/--num-processes/--process-id`` for each), watches them,
-and on ANY rank death kills the survivors and relaunches ALL ranks —
+``--coordinator/--num-processes/--process-id`` for each, plus
+``--coord-dir/--coord-epoch`` wiring the boundary-agreement control
+plane — parallel/coord.py — with a fresh epoch per attempt so a
+restarted job can never read a killed attempt's stale votes), watches
+them, and on ANY rank death kills the survivors and relaunches ALL ranks —
 with ``--resume`` appended when the job has durable state
 (``--checkpoint-dir`` or ``--ledger``), so the restarted job continues
 from the last shared snapshot / journal and (because fused-sweep resume
@@ -42,6 +45,15 @@ matrix):
   stopped advancing — a wedged collective or dead I/O that exit-code
   polling can never see. The job is killed and coordinate-restarted,
   consuming one retry.
+- COLLECTIVE WEDGE (rank death under SPMD): when a rank dies hard, its
+  survivors don't crash — they freeze inside the collective (or the
+  coord plane's boundary barrier) waiting for the dead peer, heartbeats
+  stuck in a ``train``/``boundary``/staging phase. The exit path
+  classifies that shape (dead rank + survivors frozen mid-collective),
+  emits ``rank_wedge``, TERM-drains the survivors with the usual
+  ``--term-grace`` escalation, and funds ONE coordinated ``--resume``
+  restart from the rank-death retry budget — the restarted ledger is
+  record-identical to an unkilled run (fused resume is bit-identical).
 
 Two non-retryable classifications cut restart storms short:
 
@@ -124,11 +136,31 @@ def _stall_phases(log_dir: str, ranks) -> dict:
     return phases
 
 
-def _spawn_ranks(n: int, rest: list[str], log_dir: str, heartbeat: bool = False):
+def _is_collective_phase(phase) -> bool:
+    """Is this last-beat phase one a rank holds while inside (or
+    waiting to enter) a collective — the shape a survivor freezes in
+    when a peer dies mid-job? ``train`` covers fused launches,
+    ``boundary*`` the boundary ops AND the coord plane's agreement
+    barrier (whose waits deliberately stop advancing beats), the
+    staging phases the transfer engine's device-side barriers."""
+    return bool(phase) and (
+        phase == "train"
+        or phase.startswith("boundary")
+        or phase.startswith("stage")
+        or phase.startswith("staging")
+    )
+
+
+def _spawn_ranks(
+    n: int, rest: list[str], log_dir: str, heartbeat: bool = False, coord=None
+):
     """One attempt's rank processes; a fresh coordinator port each time
     (the previous attempt's port may linger in TIME_WAIT). With
     ``heartbeat`` each rank gets ``--heartbeat-file`` pointed at its
-    per-rank file under ``log_dir`` (the stall watchdog's input)."""
+    per-rank file under ``log_dir`` (the stall watchdog's input).
+    ``coord`` is ``(dir, epoch)`` wiring each rank's boundary-agreement
+    plane — the epoch is the supervisor's relaunch counter, so every
+    attempt votes in a namespace no dead attempt ever touched."""
     port = _free_port()
     # rank env is INHERITED (Popen env=None): MPI_OPT_TPU_CACHE_DIR
     # reaches every restart/resume attempt of every rank, where
@@ -157,6 +189,8 @@ def _spawn_ranks(n: int, rest: list[str], log_dir: str, heartbeat: bool = False)
             ]
             if heartbeat:
                 argv += ["--heartbeat-file", _hb_path(log_dir, i)]
+            if coord is not None:
+                argv += ["--coord-dir", coord[0], "--coord-epoch", str(coord[1])]
             out = open(os.path.join(log_dir, f"rank{i}.out"), "w")
             err = open(os.path.join(log_dir, f"rank{i}.err"), "w")
             try:
@@ -382,6 +416,8 @@ def main(argv=None) -> int:
         "--process-id",
         "--retries",
         "--heartbeat-file",
+        "--coord-dir",
+        "--coord-epoch",
     ):
         if _has_flag(rest, banned):
             parser.error(
@@ -390,6 +426,18 @@ def main(argv=None) -> int:
             )
     log_dir = args.log_dir or tempfile.mkdtemp(prefix="mpi_opt_tpu_launch_")
     os.makedirs(log_dir, exist_ok=True)
+    coord_root = None
+    if args.n_proc > 1:
+        # the boundary-agreement control plane (parallel/coord.py)
+        # lives under the supervisor's log dir; wipe it via the coord
+        # module's own reset (the agreement surface has one writer) so
+        # a reused --log-dir cannot leak a previous JOB's epochs —
+        # between this job's own attempts the advancing --coord-epoch
+        # is the isolation, no wipe needed while ranks may be reading
+        coord_root = os.path.join(log_dir, "coord")
+        from mpi_opt_tpu.parallel.coord import reset_dir
+
+        reset_dir(coord_root)
 
     # --resume on restart is valid whenever the job has durable state to
     # continue from: orbax snapshots (--checkpoint-dir) or the trial
@@ -467,7 +515,13 @@ def main(argv=None) -> int:
                     args.stall_timeout,
                 )
             t_attempt = time.monotonic()
-            procs = _spawn_ranks(args.n_proc, rank_args, log_dir, heartbeat=watch_stalls)
+            procs = _spawn_ranks(
+                args.n_proc,
+                rank_args,
+                log_dir,
+                heartbeat=watch_stalls,
+                coord=None if coord_root is None else (coord_root, relaunches),
+            )
             kind, info = _watch(
                 procs, args.poll_interval, args.term_grace, detector, guard
             )
@@ -552,6 +606,36 @@ def main(argv=None) -> int:
             rc = procs[failed][0].returncode
             with open(os.path.join(log_dir, f"rank{failed}.err")) as f:
                 tail = f.read()[-2000:]
+            # every rank's LAST heartbeat phase (the files survive
+            # _stop_all): the failed rank's phase says WHERE it died;
+            # survivors frozen in a collective-holding phase are the
+            # wedge signature classified below. Empty without
+            # --stall-timeout (no heartbeats wired).
+            phases = (
+                _stall_phases(log_dir, range(args.n_proc)) if watch_stalls else {}
+            )
+            failed_phase = phases.get(str(failed))
+            at_note = f" during {failed_phase}" if failed_phase else ""
+            wedged = [
+                i
+                for i in range(args.n_proc)
+                if i != failed and _is_collective_phase(phases.get(str(i)))
+            ]
+            if wedged and rc not in (EX_TEMPFAIL, EX_DATAERR, EX_USAGE):
+                # collective wedge: the dead rank left its survivors
+                # frozen mid-collective (they were TERM-drained, then
+                # killed after --term-grace, by _watch's _stop_all).
+                # The generic restart below IS the coordinated
+                # recovery — this event names the shape so operators
+                # (and the SPMD drill) see the classification, not
+                # just a bare rank death
+                _event(
+                    "rank_wedge",
+                    rank=failed,
+                    returncode=rc,
+                    survivors=wedged,
+                    phases=phases,
+                )
             if rc == EX_TEMPFAIL:
                 # the graceful-shutdown protocol: the rank drained and
                 # flushed before exiting. A coordinated resume costs the
@@ -659,19 +743,23 @@ def main(argv=None) -> int:
                     "failed",
                     rank=failed,
                     returncode=rc,
+                    phase=failed_phase,
                     attempts=attempt + 1,
                     preemptions=preemptions,
                     stalls_detected=stalls,
                 )
                 sys.stderr.write(
-                    f"rank {failed} died (rc={rc}); retries exhausted. "
-                    f"Last stderr:\n{tail}\n"
+                    f"rank {failed} died (rc={rc}){at_note}; retries "
+                    f"exhausted. Last stderr:\n{tail}\n"
                 )
                 return 1
             if _crash_looping(attempt_wall):
                 sys.stderr.write(f"last rank stderr:\n{tail}\n")
                 return _crash_loop_abort(
-                    f"last: rank {failed} rc={rc}", rank=failed, returncode=rc
+                    f"last: rank {failed} rc={rc}{at_note}",
+                    rank=failed,
+                    returncode=rc,
+                    phase=failed_phase,
                 )
             attempt += 1
             delay = _backoff_s(attempt, args.restart_backoff, 0.5, backoff_rng)
@@ -680,6 +768,8 @@ def main(argv=None) -> int:
                 "restart",
                 rank=failed,
                 returncode=rc,
+                phase=failed_phase,
+                wedge=bool(wedged),
                 attempt=attempt,
                 of=args.retries,
                 backoff_s=round(delay, 3),

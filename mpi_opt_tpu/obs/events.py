@@ -61,6 +61,15 @@ EVENTS = frozenset(
         "stall_restart",
         "preempted",
         "preempt_restart",
+        # multi-process SPMD coordination (parallel/coord.py + the
+        # supervisor's wedge classification): rank_agreed = a boundary
+        # decision (drain / wave cap / OOM halving) settled unanimously
+        # through the control plane; rank_wedge = a rank (or the
+        # supervisor, observing dead-rank-plus-frozen-survivors)
+        # concluded a peer never reached the boundary — the collective
+        # is wedged and a coordinated restart is the recovery
+        "rank_agreed",
+        "rank_wedge",
         # sweep service (service/scheduler.py)
         "serve_start",
         "slice_start",

@@ -137,6 +137,42 @@ def constrain_pop(tree: Any, mesh: Optional[Mesh]) -> Any:
     )
 
 
+def spans_processes(mesh: Mesh) -> bool:
+    """Does this mesh place shards on devices owned by OTHER processes?
+    The staging layer branches on this: a host-local mesh stages waves
+    with plain ``device_put`` (which rejects non-addressable targets),
+    a process-spanning one must assemble global arrays per shard."""
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
+def shard_popstate_global(state: Any, mesh: Mesh) -> Any:
+    """Process-spanning twin of ``shard_popstate``: place a host pytree
+    (every process holds the FULL host copy — SPMD ranks derive
+    identical pools from identical code) so the member axis shards over
+    ``pop`` across ALL processes' devices.
+
+    ``jax.device_put`` cannot target non-addressable devices, so each
+    leaf is assembled with ``jax.make_array_from_callback``: every
+    process contributes only the index-slices its local devices own,
+    read out of its full host copy — no cross-host data movement at
+    all, which is exactly the MPI world's "each rank stages its own
+    shard". Non-dividing member axes replicate with the standard
+    warning, same contract as the host-local path.
+    """
+    n_pop = mesh.shape["pop"]
+    bad = sorted({l.shape[0] for l in jax.tree.leaves(state) if l.shape[0] % n_pop})
+    for n in bad:
+        _warn_replicated(n, n_pop)
+
+    def _place(x):
+        x = np.asarray(x)
+        sh = pop_sharding(mesh) if x.shape[0] % n_pop == 0 else replicate(mesh)
+        return jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
+
+    return jax.tree.map(_place, state)
+
+
 def fetch_global(x) -> np.ndarray:
     """Host copy of a possibly multi-process-sharded array.
 

@@ -123,13 +123,37 @@ def launch_boundary(stage: str, *, final: bool, snapshot=None, **progress) -> No
     parks through the identical flush-snapshot-and-raise path a
     platform SIGTERM takes, and its ledger/snapshot state cannot
     differ from a preempted run's.
+
+    Under multi-process SPMD the same hook slot carries the coord
+    plane's drain agreement (``parallel/coord.py``): the tick votes
+    this rank's shutdown flag into the boundary's barrier, and the
+    drain below additionally requires the AGREED verdict
+    (``coord.drain_allowed``) — a SIGTERM that landed on one rank
+    after this boundary's vote closed must wait for the next
+    boundary's vote, or half the world drains while the other half
+    issues the next collective alone. The ``resources.boundary_fault``
+    seam fires first: the ``rank_kill`` chaos injector counts 1-based
+    boundary ordinals here.
     """
     from mpi_opt_tpu.health import heartbeat, shutdown
+    from mpi_opt_tpu.parallel import coord
+    from mpi_opt_tpu.utils import resources
 
+    if coord.active_plane() is not None:
+        # multi-process: label the beat (and a drain's ``at``) as a
+        # boundary phase — a rank frozen HERE is waiting in the
+        # agreement barrier, the exact last-beat shape launch.py's
+        # collective-wedge classifier keys on; and identical labels
+        # across ranks let drills assert "all ranks drained at the
+        # same boundary" from the summaries alone
+        stage = f"boundary:{stage}"
+    resources.boundary_fault(stage)
     heartbeat.beat(stage=stage, **progress)
     if not final:
         shutdown.poll_slice(stage)
     if final or not shutdown.requested():
+        return
+    if not coord.drain_allowed():
         return
     if snapshot is not None:
         snapshot()

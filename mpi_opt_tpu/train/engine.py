@@ -127,14 +127,20 @@ def resolve_wave_size(trainer, sample_x, population: int, *, wave_size, mesh=Non
     """Resolve a requested wave cap (``'auto'`` or int) for a
     ``population``-member fused sweep — the ONE sizing door every
     wave-capable driver goes through, so ``auto`` estimation, the
-    pre-clamp of explicit caps, and the multi-process refusal cannot
-    drift between algorithms.
+    pre-clamp of explicit caps, and the multi-process cap agreement
+    cannot drift between algorithms.
 
     Returns the resolved integer cap; 0 (or a cap >= population) means
     resident mode, the bit-identical baseline. With ``oom_backoff``
     enabled and a MEASURED device budget (obs/memory.py), an explicit
     cap above the residency estimate is pre-clamped (``wave_resized``
     event) so the common case never pays an OOM to learn the answer.
+
+    Under multi-process SPMD (an active ``parallel/coord.py`` plane),
+    each rank sizes against ITS host's budget and then the settled cap
+    is min-agreed through the control plane — all ranks must run the
+    same wave schedule or their collectives diverge, and the most
+    memory-constrained host is the binding one.
     """
     if not wave_size:
         return 0
@@ -176,12 +182,29 @@ def resolve_wave_size(trainer, sample_x, population: int, *, wave_size, mesh=Non
                     population=population,
                 )
                 wave_size = est
-    if 0 < wave_size < population and jax.process_count() > 1:
-        raise ValueError(
-            "wave scheduling stages members through THIS process's "
-            "host memory; under multi-process SPMD shard the "
-            "population over the mesh 'pop' axis instead"
-        )
+    from mpi_opt_tpu.parallel import coord
+
+    plane = coord.active_plane()
+    if plane is not None and 0 < wave_size:
+        # every rank proposes its locally-settled cap (a cap at or
+        # above the population still constrains a peer that sized
+        # smaller, so it votes its true value, clamped to resident);
+        # min-agreement picks the most constrained host's answer.
+        # Without a plane a multi-process run still proceeds — SPMD
+        # ranks derive identical caps from identical code on
+        # homogeneous hosts — but heterogeneous budgets and OOM
+        # absorption need the agreement (the backoff handler refuses
+        # to halve unilaterally).
+        agreed = plane.agree_cap("wave_cap", min(wave_size, population))
+        if agreed and agreed != wave_size:
+            resources.notify(
+                "wave_resized",
+                requested=wave_size,
+                wave_size=agreed,
+                population=population,
+                agreed=True,
+            )
+            wave_size = agreed
     return wave_size
 
 
@@ -410,6 +433,7 @@ class WaveRunner:
         import numpy as np
 
         from mpi_opt_tpu.health import heartbeat
+        from mpi_opt_tpu.parallel import coord
 
         while True:  # one iteration per OOM-backoff attempt
             wave_lens, offs, n_waves = wave_layout(n, self.wave_size)
@@ -491,39 +515,67 @@ class WaveRunner:
                         # interval's peak residency (two waves +
                         # activations) just happened
                         memory.note(sp)
-                return wave_scores
+                local_oom = None
             except resources.DeviceOOM as e:
                 if self.oom_budget <= 0 or self.wave_size <= 1:
                     # no wave left to halve (or backoff disabled):
-                    # the classified answer propagates — CLI exit 74
+                    # the classified answer propagates — CLI exit 74.
+                    # Under a coord plane the peers waiting at this
+                    # interval's agreement barrier wedge out on their
+                    # timeout and exit too — the supervisor's
+                    # coordinated restart is the recovery either way
                     raise
-                self.oom_budget -= 1
-                self.oom_backoffs += 1
-                # settle what completed; a transfer that died WITH
-                # the OOM latched its error in the engine — roll it
-                # over (accounting carried) so re-run stage-outs
-                # aren't refused on sight
-                try:
-                    self.engine.drain()
-                # sweeplint: disable=drain-swallow -- settling in-flight transfers before the backoff re-run: the error here is the same already-classified OOM this handler is absorbing, and the engine is rolled over fresh below
-                except BaseException:
-                    pass
-                self.engine = engine_rollover(self.engine)
-                self.wave_size = max(1, self.wave_size // 2)
-                # re-run THIS interval from wave 0 under the new split:
-                # pool reads are non-destructive, the interval's keys
-                # are already derived, and rewritten pool rows carry
-                # identical values — bit-identity is preserved
-                scores_host[:] = np.nan
-                start_wave = 0
-                resources.notify(
-                    "oom_backoff",
-                    **dict(notify_fields),
-                    wave_size=self.wave_size,
-                    remaining=self.oom_budget,
-                    error=str(e)[:300],
-                )
-                continue
+                if coord.active_plane() is None and jax.process_count() > 1:
+                    # halving unilaterally would put this rank on a
+                    # different wave schedule than its peers; without
+                    # the control plane the only coordinated recovery
+                    # is a job-level restart
+                    raise
+                local_oom = e
+
+            # OOM agreement (multi-process SPMD): one barrier per
+            # interval attempt on EVERY rank — a clean rank votes cap 0
+            # ("no local constraint"), an OOMed rank votes its halved
+            # cap; min-agreement means the whole cohort absorbs the
+            # most constrained rank's halving together, so budgets and
+            # wave schedules stay lockstep. Without a plane the local
+            # proposal stands (single-process: local IS global).
+            proposed = 0 if local_oom is None else max(1, self.wave_size // 2)
+            plane = coord.active_plane()
+            agreed = plane.agree_cap("oom", proposed) if plane is not None else proposed
+            if not agreed:
+                return wave_scores
+            self.oom_budget -= 1
+            self.oom_backoffs += 1
+            # settle what completed; a transfer that died WITH
+            # the OOM latched its error in the engine — roll it
+            # over (accounting carried) so re-run stage-outs
+            # aren't refused on sight
+            try:
+                self.engine.drain()
+            # sweeplint: disable=drain-swallow -- settling in-flight transfers before the backoff re-run: the error here is the same already-classified OOM this handler is absorbing, and the engine is rolled over fresh below
+            except BaseException:
+                pass
+            self.engine = engine_rollover(self.engine)
+            self.wave_size = agreed
+            # re-run THIS interval from wave 0 under the new split:
+            # pool reads are non-destructive, the interval's keys
+            # are already derived, and rewritten pool rows carry
+            # identical values — bit-identity is preserved
+            scores_host[:] = np.nan
+            start_wave = 0
+            resources.notify(
+                "oom_backoff",
+                **dict(notify_fields),
+                wave_size=self.wave_size,
+                remaining=self.oom_budget,
+                error=(
+                    str(local_oom)[:300]
+                    if local_oom is not None
+                    else "agreed backoff: device OOM on a peer rank"
+                ),
+            )
+            continue
 
     def result_extras(self) -> dict:
         """The wave-observability result fields every wave-scheduled

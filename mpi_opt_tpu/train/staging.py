@@ -92,13 +92,47 @@ def stage_in(pool: Any, rows: np.ndarray, mesh=None) -> Any:
     does not divide the axis). device_put is async — dispatching the
     wave's compute right after overlaps the upload with whatever the
     device is still finishing.
+
+    Under multi-process SPMD every process holds the FULL host pool
+    (identical by construction: the stage-in permutation is derived
+    from in-jit RNG decisions every rank computes identically — the
+    PERF_NOTES round-6 moral) and this function stages ITS devices'
+    shard of the wave: a process-spanning mesh routes through
+    ``shard_popstate_global``, whose per-shard callback reads only the
+    rows this process's devices own.
     """
     sliced = jax.tree.map(lambda l: l[rows], pool)
     if mesh is None:
         return jax.device_put(sliced)
-    from mpi_opt_tpu.parallel.mesh import shard_popstate
+    from mpi_opt_tpu.parallel.mesh import (
+        shard_popstate,
+        shard_popstate_global,
+        spans_processes,
+    )
 
+    if spans_processes(mesh):
+        return shard_popstate_global(sliced, mesh)
     return shard_popstate(sliced, mesh)
+
+
+def _fetch_tree(tree: Any) -> Any:  # sweeplint: barrier(the staging worker's fetch IS the wave's completion barrier — it blocks on the transfer thread, never the main loop)
+    """Host copy of a wave's trained state, on the staging worker.
+
+    The common case (host-local mesh or no mesh) is one batched
+    ``jax.device_get``. Under a process-spanning mesh the leaves are
+    NOT fully addressable and the fetch routes through
+    ``fetch_global_batched`` — a collective (``process_allgather``), so
+    it relies on the engine's strict-FIFO worker and the SPMD ranks'
+    identical stage_out order: every process's staging thread issues
+    the same collectives in the same sequence, the same discipline the
+    deferred ledger flush already depends on.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    if any(isinstance(l, jax.Array) and not l.is_fully_addressable for l in leaves):
+        from mpi_opt_tpu.parallel.mesh import fetch_global_batched
+
+        return jax.tree.unflatten(treedef, fetch_global_batched(leaves))
+    return jax.device_get(tree)
 
 
 def write_rows(pool: Any, lo: int, host_tree: Any) -> None:
@@ -175,7 +209,7 @@ class StagingEngine:
                     # device_get blocks until the arrays' producing programs
                     # finish — this IS the wave's completion barrier, paid
                     # on this thread while the main thread dispatches ahead
-                    host = jax.device_get(tree)
+                    host = _fetch_tree(tree)
                     on_host(host)
                     n_bytes = tree_bytes(host)
                     sp["bytes"] = n_bytes
@@ -399,6 +433,12 @@ def estimate_wave_size(
     it (replicated waves would defeat the mesh silently). Returns a
     value in [1, population]; ``population`` means everything fits —
     callers run resident mode.
+
+    Under multi-process SPMD this is a PER-HOST estimate (the budget
+    sources — env override, ``memory_stats`` — describe the local
+    devices); ``resolve_wave_size`` min-agrees the settled cap across
+    ranks through the coord plane, so heterogeneous hosts converge on
+    the most constrained one's answer rather than each guessing.
     """
     per_member = _per_member_bytes(trainer, sample_x)
     if budget_bytes is None:

@@ -212,3 +212,22 @@ def set_launch_fault_injector(fn: Optional[Callable[[str], None]]) -> None:
 def launch_fault(kind: str) -> None:
     if _LAUNCH_FAULTS is not None:
         _LAUNCH_FAULTS(kind)
+
+
+# ``boundary_fault(stage)`` sits at the top of every launch/rung/
+# generation boundary (train.common.launch_boundary) — the seam the
+# ``rank_kill`` chaos injector hangs off to SIGKILL a chosen rank at a
+# chosen 1-based boundary ordinal, the one fault shape that wedges an
+# SPMD cohort mid-collective.
+
+_BOUNDARY_FAULTS: Optional[Callable[[str], None]] = None
+
+
+def set_boundary_fault_injector(fn: Optional[Callable[[str], None]]) -> None:
+    global _BOUNDARY_FAULTS
+    _BOUNDARY_FAULTS = fn
+
+
+def boundary_fault(stage: str) -> None:
+    if _BOUNDARY_FAULTS is not None:
+        _BOUNDARY_FAULTS(stage)

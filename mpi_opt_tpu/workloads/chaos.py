@@ -318,6 +318,115 @@ def inject_oom(at_launch: int = 1, n: int = 1, kind: str | None = None):
     return injector, uninstall
 
 
+class RankKillInjector:
+    """The schedule ``inject_rank_kill`` installs into
+    ``utils.resources``' boundary seam (``train.common.launch_boundary``
+    ticks it once per launch/rung/generation boundary): on the
+    scheduled 1-based boundary ordinals, IF this process is the chosen
+    rank, die by SIGKILL — no handlers, no atexit, no flushes, exactly
+    the hard rank death that wedges an SPMD cohort's survivors in their
+    next collective. Other ranks count the same ordinals and do
+    nothing, so the drill is deterministic across the whole world.
+
+    ``once_marker``: path of a sentinel file created (O_EXCL) just
+    before dying. A coordinated ``--resume`` relaunch re-runs the same
+    boundaries with the same injector spec — without the marker the
+    restarted rank would be killed at the same ordinal forever, burning
+    the retry budget on the drill itself. Marker present = already
+    fired = don't fire again.
+    """
+
+    def __init__(
+        self,
+        rank: int = 0,
+        at_boundary: int = 1,
+        n: int = 1,
+        once_marker: str | None = None,
+    ):
+        import threading
+
+        if at_boundary < 1:
+            raise ValueError(f"at_boundary is 1-based, got {at_boundary}")
+        self._lock = threading.Lock()
+        self._rank = int(rank)
+        self._fire_at = frozenset(range(at_boundary, at_boundary + max(1, n)))
+        self._once_marker = once_marker
+        self.boundaries = 0
+        self.faults_fired = 0
+
+    def __call__(self, stage: str) -> None:
+        with self._lock:
+            self.boundaries += 1
+            fire = self.boundaries in self._fire_at
+        if not fire:
+            return
+        import jax
+
+        if jax.process_index() != self._rank:
+            return
+        if self._once_marker is not None:
+            try:
+                fd = os.open(
+                    self._once_marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY
+                )
+                os.close(fd)
+            except FileExistsError:
+                return  # already fired in a previous attempt
+        with self._lock:
+            self.faults_fired += 1
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def parse_rank_kill_spec(spec: str) -> dict:
+    """``"rank=1,at=3,n=1,marker=/tmp/m"`` -> ``inject_rank_kill``
+    kwargs. Unknown keys are rejected loudly, same contract as
+    ``parse_chaos_spec`` — a typoed drill spec injecting nothing would
+    fake a green wedge drill."""
+    out: dict = {}
+    keys = {"rank": int, "at": int, "n": int, "marker": str}
+    names = {"rank": "rank", "at": "at_boundary", "n": "n", "marker": "once_marker"}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"rank-kill spec entry {part!r} is not key=value "
+                f"(known keys: {sorted(keys)})"
+            )
+        k, v = part.split("=", 1)
+        k = k.strip()
+        if k not in keys:
+            raise ValueError(f"unknown rank-kill key {k!r} (known: {sorted(keys)})")
+        out[names[k]] = keys[k](v)
+    return out
+
+
+def inject_rank_kill(
+    rank: int = 0,
+    at_boundary: int = 1,
+    n: int = 1,
+    once_marker: str | None = None,
+):
+    """Install a deterministic rank-death schedule on the boundary
+    seam: at the ``at_boundary``-th launch/rung/generation boundary
+    (1-based; ``n`` consecutive ordinals), the process whose
+    ``jax.process_index()`` equals ``rank`` SIGKILLs itself. Returns
+    ``(injector, uninstall)`` like ``inject_oom``; ``once_marker``
+    makes the kill one-shot across coordinated restarts."""
+    from mpi_opt_tpu.utils import resources
+
+    injector = RankKillInjector(
+        rank=rank, at_boundary=at_boundary, n=n, once_marker=once_marker
+    )
+    resources.set_boundary_fault_injector(injector)
+
+    def uninstall() -> None:
+        resources.set_boundary_fault_injector(None)
+
+    return injector, uninstall
+
+
 # -- spool-fault injectors (fleet federation, ISSUE 12) ---------------------
 #
 # The two injectors above strike durable state BETWEEN runs; these

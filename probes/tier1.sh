@@ -25,6 +25,7 @@
 #        T1_SKIP_CORPUS_DRILL=1 probes/tier1.sh # skip the corpus/auto-warm-start drill
 #        T1_SKIP_FRONTDOOR_DRILL=1 probes/tier1.sh # skip the HTTP front-door drill
 #        T1_SKIP_PARETO_DRILL=1 probes/tier1.sh # skip the multi-objective drill
+#        T1_SKIP_SPMD_DRILL=1 probes/tier1.sh # skip the multi-process SPMD drill
 set -o pipefail
 cd "$(dirname "$0")/.."
 T1_LOG="${T1_LOG:-/tmp/_t1.log}"
@@ -963,6 +964,30 @@ PYEOF
         echo "RACE_DRILL=pass"
     else
         echo "RACE_DRILL=FAIL"
+        rc=$(( rc == 0 ? 1 : rc ))
+    fi
+fi
+
+# -- SPMD drill (rank-fault-tolerant multi-process, parallel/coord.py; ISSUE 20) --
+# The full escalation ladder as a real 2-rank launch.py run: a chaos
+# rank-kill SIGKILLs rank 1 at its second boundary, the survivor
+# freezes in the agreement barrier (last beat `boundary:*`), the
+# supervisor classifies the COLLECTIVE WEDGE (rank_wedge event),
+# TERM-drains the survivor after --term-grace, and restarts BOTH ranks
+# coordinated (--resume, fresh coord epoch) — completing with a ledger
+# record-identical to an unkilled 2-rank reference run's. Slow-marked
+# in pytest (two supervised multi-rank sweeps), so tier-1 runs it here
+# as a drill instead of inside the 870 s suite budget.
+if [ -z "$T1_SKIP_SPMD_DRILL" ]; then
+    sp_rc=0
+    timeout -k 10 580 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_coord.py -q -m slow -k escalates \
+        -p no:cacheprovider -p no:xdist -p no:randomly \
+        >/dev/null 2>&1 || sp_rc=1
+    if [ $sp_rc -eq 0 ]; then
+        echo "SPMD_DRILL=pass"
+    else
+        echo "SPMD_DRILL=FAIL"
         rc=$(( rc == 0 ? 1 : rc ))
     fi
 fi

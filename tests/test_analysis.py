@@ -568,6 +568,75 @@ def test_corpus_index_write_true_negative():
     )
 
 
+# -- coord-write (ISSUE 20) -----------------------------------------------
+
+
+def test_coord_write_true_positive():
+    from mpi_opt_tpu.analysis.checkers_coord import CoordWriteChecker
+
+    # direct write of a decision file outside the plane module
+    f1 = run_one(
+        CoordWriteChecker(),
+        """
+        import json
+        def publish(edir, doc):
+            with open(edir + "/drain.000001.decision.json", "w") as f:
+                json.dump(doc, f)
+        """,
+        path="mpi_opt_tpu/launch.py",
+    )
+    assert [f.check for f in f1] == ["coord-write"]
+    # os.open of a vote path — the O_EXCL create is plane-only
+    f2 = run_one(
+        CoordWriteChecker(),
+        """
+        import os
+        def vote(vote_path):
+            return os.open(vote_path, os.O_CREAT | os.O_EXCL)
+        """,
+    )
+    assert [f.check for f in f2] == ["coord-write"]
+    # rename onto a coord path, and unlink under live readers
+    f3 = run_one(
+        CoordWriteChecker(),
+        """
+        import os
+        def scrub(tmp, coord_dir):
+            os.replace(tmp, coord_dir + "/READY.json")
+            os.unlink(coord_dir + "/READY.json")
+        """,
+        path="tests/test_something.py",
+    )
+    assert [f.check for f in f3] == ["coord-write"] * 2
+
+
+def test_coord_write_true_negative():
+    from mpi_opt_tpu.analysis.checkers_coord import CoordWriteChecker
+
+    clean = """
+    import json, os
+    def read_side(edir, coordinator, log_path):
+        with open(edir + "/drain.000001.decision.json") as f:  # reads free
+            doc = json.load(f)
+        with open(log_path, "w") as f:       # non-coord write
+            f.write(coordinator)             # jax addr plumbing != coord
+        os.replace("hb.tmp", "hb.json")      # non-coord replace
+        return doc
+    """
+    assert run_one(CoordWriteChecker(), clean, path="mpi_opt_tpu/cli.py") == []
+    # the plane's own home is the one legal writer
+    inside = """
+    import os
+    def decide(path, tmp):
+        os.replace(tmp, path)
+        return os.open(path + ".vote.json", os.O_CREAT | os.O_EXCL)
+    """
+    assert (
+        run_one(CoordWriteChecker(), inside, path="mpi_opt_tpu/parallel/coord.py")
+        == []
+    )
+
+
 # -- racelint: guarded-by (ISSUE 15) --------------------------------------
 
 

@@ -371,3 +371,88 @@ def test_injectors_target_explicit_step_and_refuse_empty_dirs(tmp_path):
     os.makedirs(empty)
     with pytest.raises(ValueError, match="no committed snapshot steps"):
         chaos.inject_torn_save(empty)
+
+
+# -- rank-death injector (multi-process SPMD wedge drills, ISSUE 20) --------
+
+
+def test_rank_kill_counts_boundaries_and_spares_other_ranks(monkeypatch):
+    """The injector counts every boundary tick on every rank, but only
+    the CHOSEN rank dies — peers tick the same ordinals and keep going,
+    which is what makes the wedge drill deterministic world-wide. Here
+    the process plays rank 0 while the schedule targets rank 1: the
+    scheduled ordinal must be a no-op."""
+    from mpi_opt_tpu.train.common import launch_boundary
+    from mpi_opt_tpu.workloads.chaos import inject_rank_kill
+
+    kills = []
+    monkeypatch.setattr(
+        "mpi_opt_tpu.workloads.chaos.os.kill",
+        lambda pid, sig: kills.append((pid, sig)),
+    )
+    inj, uninstall = inject_rank_kill(rank=1, at_boundary=2)
+    try:
+        for i in range(3):
+            launch_boundary(f"gen {i + 1}/3", final=i == 2)
+    finally:
+        uninstall()
+    assert inj.boundaries == 3
+    assert inj.faults_fired == 0 and kills == []
+    # uninstalled: the seam is inert again
+    launch_boundary("gen 1/1", final=True)
+    assert inj.boundaries == 3
+
+
+def test_rank_kill_fires_on_own_rank_once_marker_suppresses(
+    tmp_path, monkeypatch
+):
+    """On the chosen rank the scheduled ordinal kills with SIGKILL —
+    after creating the once-marker, so a coordinated --resume rerun of
+    the same boundaries with the same spec does NOT re-fire (the drill
+    must cost the supervisor exactly one restart)."""
+    import os
+    import signal as _signal
+
+    from mpi_opt_tpu.workloads.chaos import RankKillInjector
+
+    kills = []
+    monkeypatch.setattr(
+        "mpi_opt_tpu.workloads.chaos.os.kill",
+        lambda pid, sig: kills.append((pid, sig)),
+    )
+    marker = str(tmp_path / "fired.once")
+    inj = RankKillInjector(rank=0, at_boundary=2, once_marker=marker)
+    inj("b1")
+    assert kills == []
+    inj("b2")
+    assert kills == [(os.getpid(), _signal.SIGKILL)]
+    assert inj.faults_fired == 1 and os.path.exists(marker)
+    # the restarted attempt replays the same ordinals: marker holds
+    again = RankKillInjector(rank=0, at_boundary=2, once_marker=marker)
+    again("b1")
+    again("b2")
+    assert kills == [(os.getpid(), _signal.SIGKILL)]  # no second kill
+    assert again.faults_fired == 0
+
+
+def test_rank_kill_spec_parses_and_rejects_unknown_keys(tmp_path):
+    from mpi_opt_tpu.workloads.chaos import parse_rank_kill_spec
+
+    assert parse_rank_kill_spec("rank=1,at=3") == {
+        "rank": 1,
+        "at_boundary": 3,
+    }
+    assert parse_rank_kill_spec("rank=0,at=2,n=2,marker=/tmp/m") == {
+        "rank": 0,
+        "at_boundary": 2,
+        "n": 2,
+        "once_marker": "/tmp/m",
+    }
+    with pytest.raises(ValueError, match="unknown rank-kill key"):
+        parse_rank_kill_spec("rank=1,boom=3")
+    with pytest.raises(ValueError, match="not key=value"):
+        parse_rank_kill_spec("rank")
+    from mpi_opt_tpu.workloads.chaos import RankKillInjector
+
+    with pytest.raises(ValueError, match="1-based"):
+        RankKillInjector(at_boundary=0)

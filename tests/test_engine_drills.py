@@ -168,6 +168,44 @@ def test_sha_oom_backoff_record_identical(wl, tmp_path):
     assert _records(tmp_path / "clean.jsonl") == _records(tmp_path / "oom.jsonl")
 
 
+def test_pbt_oom_backoff_record_identical(wl, tmp_path):
+    """Fused PBT rides the SAME shared engine (ISSUE 20 closes the
+    chaos matrix): an OOM injected into generation 2's first wave
+    (W=4 over pop 8: two waves per gen, ordinal 3) halves the cap,
+    re-runs that generation's waves from the already-derived keys, and
+    the sweep ends bit-identical to the clean run with a
+    record-identical ledger."""
+    import mpi_opt_tpu.train.fused_pbt as fp
+
+    kw = dict(population=8, generations=3, steps_per_gen=2, seed=2)
+    space = wl.default_space()
+    led_a = _ledger(tmp_path / "clean.jsonl", space, "pbt", kw["seed"])
+    try:
+        clean = fp.fused_pbt(wl, wave_size=4, ledger=led_a, **kw)
+    finally:
+        led_a.close()
+
+    inj, uninstall = inject_oom(at_launch=3, kind="wave")
+    led_b = _ledger(tmp_path / "oom.jsonl", space, "pbt", kw["seed"])
+    try:
+        faulted = fp.fused_pbt(
+            wl, wave_size=4, oom_backoff=2, ledger=led_b, **kw
+        )
+    finally:
+        led_b.close()
+        uninstall()
+
+    assert inj.faults_fired == 1
+    assert faulted["oom_backoffs"] == 1
+    assert faulted["wave_size"] == 2  # settled cap after one halving
+    np.testing.assert_array_equal(clean["best_curve"], faulted["best_curve"])
+    np.testing.assert_array_equal(clean["unit"], faulted["unit"])
+    assert clean["best_score"] == faulted["best_score"]
+    assert clean["best_params"] == faulted["best_params"]
+    assert validate_ledger(led_b.path) == []
+    assert _records(tmp_path / "clean.jsonl") == _records(tmp_path / "oom.jsonl")
+
+
 def test_tpe_oom_backoff_record_identical(wl, tmp_path):
     """Same drill through the TPE adapter: the batch re-runs from its
     already-drawn suggestions (the suggest program is NOT re-entered, so

@@ -38,13 +38,14 @@ def _sweep_args(ck):
     ]
 
 
-def _run_supervisor(n_proc, retries, rank_args, log_dir, timeout=900):
+def _run_supervisor(n_proc, retries, rank_args, log_dir, timeout=900, extra=()):
     p = subprocess.Popen(
         [
             sys.executable, "-m", "mpi_opt_tpu.launch",
             "--n-proc", str(n_proc),
             "--retries", str(retries),
             "--log-dir", log_dir,
+            *extra,
             "--", *rank_args,
         ],
         stdout=subprocess.PIPE,
@@ -272,7 +273,7 @@ def test_supervisor_backs_off_between_restarts(tmp_path, monkeypatch):
     sleeps = []
     monkeypatch.setattr(launch.time, "sleep", lambda s: sleeps.append(s))
 
-    def fake_spawn(n, rest, log_dir, heartbeat=False):
+    def fake_spawn(n, rest, log_dir, heartbeat=False, coord=None):
         procs = []
         for i in range(n):
             out = open(os.path.join(log_dir, f"rank{i}.out"), "w")
@@ -320,7 +321,7 @@ time.sleep(300)
 
 
 def _fake_spawn_script(script, argv_of=lambda log_dir, i: []):
-    def fake_spawn(n, rest, log_dir, heartbeat=False):
+    def fake_spawn(n, rest, log_dir, heartbeat=False, coord=None):
         procs = []
         for i in range(n):
             out = open(os.path.join(log_dir, f"rank{i}.out"), "w")
@@ -383,7 +384,7 @@ def test_supervisor_sigterm_drains_ranks_and_exits_75(tmp_path, monkeypatch):
     spawned = []
     inner = _fake_spawn_script("import time; time.sleep(300)")
 
-    def recording_spawn(n, rest, log_dir, heartbeat=False):
+    def recording_spawn(n, rest, log_dir, heartbeat=False, coord=None):
         procs = inner(n, rest, log_dir, heartbeat)
         spawned.extend(p for p, _, _ in procs)
         return procs
@@ -593,7 +594,7 @@ def test_stall_watchdog_ignores_ranks_that_exited_cleanly(tmp_path, monkeypatch,
     not let it get the still-working survivor killed (staggered finishes
     are normal: uneven final launches)."""
 
-    def fake_spawn(n, rest, log_dir, heartbeat=False):
+    def fake_spawn(n, rest, log_dir, heartbeat=False, coord=None):
         procs = []
         for i in range(n):
             out = open(os.path.join(log_dir, f"rank{i}.out"), "w")

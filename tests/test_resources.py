@@ -108,7 +108,7 @@ def test_supervisor_aborts_on_resource_error_without_retrying(
     operator frees the resource — the supervisor must abort with
     diagnostics, budget untouched (the exit-65 rule's sibling)."""
 
-    def fake_spawn(n, rest, log_dir, heartbeat=False):
+    def fake_spawn(n, rest, log_dir, heartbeat=False, coord=None):
         procs = []
         for i in range(n):
             out = open(os.path.join(log_dir, f"rank{i}.out"), "w")
